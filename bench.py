@@ -1,0 +1,53 @@
+"""Benchmark: pi(1e9), odds packing, jax backend on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: BASELINE.md's measured CPU floor — pi(1e9) segmented numpy in
+7.5 s single process == 1.33e8 values/s. vs_baseline is the speedup of
+this run's values/s over that floor. Exact pi parity is asserted before
+any number is printed: a fast wrong sieve scores zero.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+N = 10**9
+PI_N = 50_847_534  # BASELINE.md oracle (computed, 2026-07-29)
+BASELINE_VALUES_PER_SEC = (N - 1) / 7.5  # BASELINE.md CPU floor
+
+
+def main() -> int:
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+
+    cfg = SieveConfig(
+        n=N, backend="jax", packing="odds", n_segments=4, twins=False, quiet=True
+    )
+    # warmup: compile every shape bucket once (first TPU compile is slow and
+    # is not the thing being measured)
+    warm = run_local(cfg)
+    assert warm.pi == PI_N, f"warmup parity failure: {warm.pi} != {PI_N}"
+
+    t0 = time.perf_counter()
+    res = run_local(cfg)
+    elapsed = time.perf_counter() - t0
+    assert res.pi == PI_N, f"parity failure: {res.pi} != {PI_N}"
+
+    values_per_sec = (N - 1) / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "sieve_throughput_pi_1e9_odds_jax",
+                "value": round(values_per_sec, 1),
+                "unit": "values/s/chip",
+                "vs_baseline": round(values_per_sec / BASELINE_VALUES_PER_SEC, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""tpu-sieve: a TPU-native distributed segmented Sieve of Eratosthenes.
+
+A ground-up rebuild of the capabilities of `dpbriggs/Distributed-Sieve-e`
+(reference mount at /root/reference was empty this round; built against the
+driver-anchored spec in SURVEY.md — see SURVEY.md "STATUS" for provenance).
+
+Architecture (SURVEY.md section 1b):
+  - coordinator computes seed primes (<= sqrt(N)) on host, partitions [2, N]
+    into contiguous bit-packed segments, merges per-segment results;
+  - a pluggable ``SieveWorker`` boundary selected by ``--backend`` runs the
+    hot segmented composite-marking loop: cpu-numpy / cpu-native (C++) /
+    cpu-cluster (sockets) on CPUs, jax / tpu-pallas on TPU;
+  - on TPU, segment ownership is a ``jax.sharding.Mesh`` axis: seed primes
+    replicate over ICI, counts merge with ``lax.psum``, twin boundary words
+    exchange with ``lax.ppermute``.
+"""
+
+__version__ = "0.1.0"
+
+from sieve.config import SieveConfig
+from sieve.worker import SegmentResult, SieveWorker
+from sieve.coordinator import Coordinator, SieveResult
+
+__all__ = [
+    "SieveConfig",
+    "SieveWorker",
+    "SegmentResult",
+    "Coordinator",
+    "SieveResult",
+    "__version__",
+]

@@ -1,0 +1,68 @@
+"""Backend registry: maps ``--backend`` names to SieveWorker implementations.
+
+SURVEY.md section 7.5: ``--backend`` selects among {cpu-numpy, cpu-native,
+cpu-cluster, jax, tpu-pallas} through the one SieveWorker boundary.
+Imports are lazy so CPU-only environments never import jax and vice versa.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable
+
+if TYPE_CHECKING:
+    from sieve.config import SieveConfig
+    from sieve.worker import SieveWorker
+
+
+def _cpu_numpy(config: "SieveConfig") -> "SieveWorker":
+    from sieve.backends.cpu_numpy import CpuNumpyWorker
+
+    return CpuNumpyWorker(config)
+
+
+def _cpu_native(config: "SieveConfig") -> "SieveWorker":
+    try:
+        from sieve.backends.cpu_native import CpuNativeWorker
+    except ImportError as e:
+        raise RuntimeError(
+            f"cpu-native backend unavailable ({e}); build it with "
+            f"`make -C csrc` or use --backend cpu-numpy"
+        ) from e
+
+    return CpuNativeWorker(config)
+
+
+def _jax(config: "SieveConfig") -> "SieveWorker":
+    from sieve.backends.jax_backend import JaxWorker
+
+    return JaxWorker(config)
+
+
+def _tpu_pallas(config: "SieveConfig") -> "SieveWorker":
+    try:
+        from sieve.backends.tpu_pallas import PallasWorker
+    except ImportError as e:
+        raise RuntimeError(
+            f"tpu-pallas backend unavailable ({e}); use --backend jax"
+        ) from e
+
+    return PallasWorker(config)
+
+
+WORKER_FACTORIES: dict[str, Callable[["SieveConfig"], "SieveWorker"]] = {
+    "cpu-numpy": _cpu_numpy,
+    "cpu-native": _cpu_native,
+    "jax": _jax,
+    "tpu-pallas": _tpu_pallas,
+}
+
+
+def make_worker(config: "SieveConfig") -> "SieveWorker":
+    try:
+        factory = WORKER_FACTORIES[config.backend]
+    except KeyError:
+        raise ValueError(
+            f"backend {config.backend!r} has no in-process worker "
+            f"(cpu-cluster runs through sieve.cluster)"
+        ) from None
+    return factory(config)

@@ -1,0 +1,110 @@
+"""JAX single-device backend (SURVEY.md milestones M2+M3, strategy A).
+
+Runs the tiered scatter-free word kernel (sieve/kernels/jax_mark.py) on the
+default device — TPU when present, CPU in CI. Segments smaller than 64
+candidate bits fall back to the numpy reference (boundary-word semantics
+for sub-word segments are a host-side concern, not worth a device kernel).
+
+Shapes are bucketed (words to WORD_BUCKET, tier-2 spec count to a power of
+two) so the jit cache stays small across segments (SURVEY.md 7.4 "avoiding
+recompilation across rounds — bounds as traced scalars, shapes static").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import numpy as np
+
+from sieve.backends.cpu_numpy import CpuNumpyWorker
+from sieve.bitset import get_layout
+from sieve.kernels.jax_mark import (
+    SPEC_BLOCK,
+    TIER1_MAX,
+    TWIN_ADJ,
+    TWIN_NONE,
+    TWIN_PLAIN,
+    TWIN_W30,
+    WORD_BUCKET,
+    mark_words,
+    next_pow2,
+)
+from sieve.kernels.specs import prepare_tiered
+from sieve.worker import SegmentResult, SieveWorker
+
+TWIN_KIND = {"plain": TWIN_PLAIN, "odds": TWIN_ADJ, "wheel30": TWIN_W30}
+
+MIN_DEVICE_BITS = 64
+
+
+def prepare_segment(packing: str, lo: int, hi: int, seeds: np.ndarray):
+    """Host prep with bucketed shapes; returns a TieredSegment."""
+    ts = prepare_tiered(
+        packing, lo, hi, seeds,
+        tier1_max=TIER1_MAX, spec_block=SPEC_BLOCK, word_bucket=WORD_BUCKET,
+    )
+    # bucket the tier-2 spec count to a power of two for jit-cache economy
+    return ts.with_spec_count(max(SPEC_BLOCK, next_pow2(ts.m2.size)))
+
+
+class JaxWorker(SieveWorker):
+    name = "jax"
+
+    def __init__(self, config):
+        super().__init__(config)
+        import jax  # deferred so CPU-only paths never need it
+
+        self._jax = jax
+        # SIEVE_JAX_PLATFORM pins the device platform (tests use "cpu" so CI
+        # never depends on — or occupies — the real TPU).
+        platform = os.environ.get("SIEVE_JAX_PLATFORM")
+        self._device = jax.devices(platform)[0] if platform else None
+        self._cpu_fallback = CpuNumpyWorker(config)
+
+    def _placement(self):
+        if self._device is None:
+            return contextlib.nullcontext()
+        return self._jax.default_device(self._device)
+
+    def process_segment(
+        self, lo: int, hi: int, seed_primes: np.ndarray, seg_id: int = 0
+    ) -> SegmentResult:
+        t0 = time.perf_counter()
+        packing = self.config.packing
+        layout = get_layout(packing)
+        nbits = layout.nbits(lo, hi)
+        if nbits < MIN_DEVICE_BITS:
+            return self._cpu_fallback.process_segment(lo, hi, seed_primes, seg_id)
+
+        ts = prepare_segment(packing, lo, hi, seed_primes)
+        twin_kind = TWIN_KIND[packing] if self.config.twins else TWIN_NONE
+        with self._placement():
+            count, twins, first32, last32 = mark_words(
+                ts.Wpad,
+                twin_kind,
+                ts.periods,
+                np.int32(nbits),
+                ts.patterns,
+                ts.m2, ts.r2, ts.K2, ts.rcp2, ts.act2,
+                ts.corr_idx, ts.corr_mask,
+                np.uint32(ts.pair_mask),
+            )
+        count = int(count) + layout.extras_in(lo, hi)
+        twin_count = (
+            int(twins) + layout.extra_twin_pairs(lo, hi)
+            if self.config.twins
+            else 0
+        )
+        return SegmentResult(
+            seg_id=seg_id,
+            lo=lo,
+            hi=hi,
+            count=count,
+            twin_count=twin_count,
+            first_word=int(first32),
+            last_word=int(last32),
+            nbits=nbits,
+            elapsed_s=time.perf_counter() - t0,
+        )

@@ -1,0 +1,76 @@
+"""Checkpoint / resume ledger (SURVEY.md section 5.4).
+
+A JSON ledger ``{config_hash, completed: {seg_id: SegmentResult}}`` written
+atomically after each completed segment (CPU path) or round (TPU path).
+``--resume`` replays the merge over ledger + remaining segments; a
+config-hash mismatch refuses to resume (the math would differ).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import TYPE_CHECKING
+
+from sieve.worker import SegmentResult
+
+if TYPE_CHECKING:
+    from sieve.config import SieveConfig
+
+LEDGER_NAME = "sieve_ledger.json"
+
+
+class LedgerMismatch(RuntimeError):
+    pass
+
+
+class Ledger:
+    def __init__(self, path: Path, config_hash: str, entries: dict[int, dict]):
+        self.path = path
+        self.config_hash = config_hash
+        self._entries = entries
+
+    @classmethod
+    def open(cls, config: "SieveConfig") -> "Ledger":
+        assert config.checkpoint_dir is not None
+        path = Path(config.checkpoint_dir) / LEDGER_NAME
+        chash = config.config_hash()
+        entries: dict[int, dict] = {}
+        if path.exists():
+            data = json.loads(path.read_text())
+            if data.get("config_hash") != chash:
+                raise LedgerMismatch(
+                    f"ledger at {path} was written for config_hash="
+                    f"{data.get('config_hash')}, current run is {chash}; "
+                    "refusing to mix results (delete the ledger or match the config)"
+                )
+            entries = {int(k): v for k, v in data.get("completed", {}).items()}
+        else:
+            path.parent.mkdir(parents=True, exist_ok=True)
+        return cls(path, chash, entries)
+
+    def completed(self) -> dict[int, SegmentResult]:
+        return {k: SegmentResult.from_dict(v) for k, v in self._entries.items()}
+
+    def record(self, res: SegmentResult) -> None:
+        """Idempotent: the ledger keys on segment id, so a segment processed
+        twice (e.g. after worker-failure reassignment) is counted once."""
+        self._entries[res.seg_id] = res.to_dict()
+        self._flush()
+
+    def _flush(self) -> None:
+        payload = {
+            "config_hash": self.config_hash,
+            "completed": {str(k): v for k, v in self._entries.items()},
+        }
+        fd, tmp = tempfile.mkstemp(dir=self.path.parent, prefix=".ledger.")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)  # atomic on POSIX
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise

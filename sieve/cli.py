@@ -1,0 +1,128 @@
+"""CLI entry point (SURVEY.md section 1a L6).
+
+Example:
+    python -m sieve --n 1e9 --backend jax --segments 256 --packing odds --twins
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from sieve.config import BACKENDS, PACKINGS, SieveConfig
+
+
+def _parse_n(text: str) -> int:
+    """Accept 1000000, 1e9, 10**12 style values."""
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    if "**" in text:
+        base, exp = text.split("**")
+        return int(base) ** int(exp)
+    val = float(text)
+    n = int(val)
+    if n != val:
+        raise argparse.ArgumentTypeError(f"--n must be an integer, got {text}")
+    return n
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sieve",
+        description="TPU-native distributed segmented Sieve of Eratosthenes",
+    )
+    p.add_argument("--n", type=_parse_n, required=True, help="sieve [2, N] inclusive (1e9 ok)")
+    p.add_argument("--backend", choices=BACKENDS, default="cpu-numpy")
+    p.add_argument("--segments", type=int, default=None, dest="n_segments")
+    p.add_argument("--segment-size", type=int, default=None, dest="segment_values",
+                   help="values per segment (alternative to --segments)")
+    p.add_argument("--packing", choices=PACKINGS, default="odds")
+    p.add_argument("--twins", action="store_true", help="also count twin-prime pairs")
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--rounds", type=int, default=1,
+                   help="TPU dispatch rounds (failure-recovery granularity)")
+    p.add_argument("--profile-dir", default=None)
+    p.add_argument("--quiet", action="store_true")
+    p.add_argument("--json", action="store_true", dest="json_output")
+    p.add_argument("--chaos-kill-worker", default=None, dest="chaos_kill",
+                   help="fault injection: 'k@s' kills worker k at segment s")
+    p.add_argument("--role", choices=("auto", "coordinator", "worker"), default="auto",
+                   help="cpu-cluster role (worker processes connect to --coordinator-addr)")
+    p.add_argument("--coordinator-addr", default="127.0.0.1:7621")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> SieveConfig:
+    return SieveConfig(
+        n=args.n,
+        backend=args.backend,
+        packing=args.packing,
+        n_segments=args.n_segments,
+        segment_values=args.segment_values,
+        twins=args.twins,
+        workers=args.workers,
+        checkpoint_dir=args.checkpoint_dir,
+        resume=args.resume,
+        rounds=args.rounds,
+        profile_dir=args.profile_dir,
+        quiet=args.quiet,
+        json_output=args.json_output,
+        chaos_kill=args.chaos_kill,
+        coordinator_addr=args.coordinator_addr,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return _run(args)
+    except (ValueError, RuntimeError, ImportError) as e:
+        print(f"sieve: error: {e}", file=sys.stderr)
+        return 2
+
+
+def _run(args: argparse.Namespace) -> int:
+    config = config_from_args(args)
+
+    if args.role == "worker":
+        from sieve.cluster import serve_worker
+
+        serve_worker(config)
+        return 0
+
+    if config.backend == "cpu-cluster":
+        from sieve.cluster import run_cluster
+
+        result = run_cluster(config)
+    elif config.backend in ("jax", "tpu-pallas") and config.workers > 1:
+        from sieve.parallel.mesh import run_mesh
+
+        result = run_mesh(config)
+    else:
+        from sieve.coordinator import run_local
+
+        result = run_local(config)
+
+    if config.json_output:
+        out = result.to_dict()
+        out.pop("segments", None)
+        print(json.dumps(out))
+    else:
+        print(f"pi({result.n}) = {result.pi}")
+        if result.twin_pairs is not None:
+            print(f"twin pairs (p, p+2 <= {result.n}) = {result.twin_pairs}")
+        print(
+            f"backend={result.backend} packing={result.packing} "
+            f"segments={result.n_segments} elapsed={result.elapsed_s:.3f}s "
+            f"({result.values_per_sec:.3e} values/s)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Typed run configuration shared by every role and backend.
+
+SURVEY.md section 2 ("Config system"): one frozen dataclass, serializable,
+everything on the config and nothing ambient. The CLI (sieve/cli.py) maps
+flags 1:1 onto these fields.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+from typing import Any
+
+PACKINGS = ("plain", "odds", "wheel30")
+BACKENDS = ("cpu-numpy", "cpu-native", "cpu-cluster", "jax", "tpu-pallas")
+
+
+@dataclasses.dataclass(frozen=True)
+class SieveConfig:
+    """Configuration for one sieve run.
+
+    ``n`` is inclusive: the run computes pi(n) (= count of primes in [2, n]).
+    Internally every range is half-open [lo, hi) with the global range being
+    [2, n + 1).
+    """
+
+    n: int
+    backend: str = "cpu-numpy"
+    packing: str = "odds"
+    # Segmentation: give either a segment count or a per-segment value span.
+    n_segments: int | None = None
+    segment_values: int | None = None
+    twins: bool = False
+    # Workers / devices.
+    workers: int = 1
+    # Checkpoint / resume (SURVEY.md section 5.4).
+    checkpoint_dir: str | None = None
+    resume: bool = False
+    # Rounds: TPU dispatch granularity for failure recovery (section 5.3).
+    rounds: int = 1
+    # Observability.
+    profile_dir: str | None = None
+    quiet: bool = False
+    json_output: bool = False
+    # Fault injection hook "--chaos-kill-worker k@segment s" (section 5.3).
+    chaos_kill: str | None = None
+    # cpu-cluster transport endpoints.
+    coordinator_addr: str = "127.0.0.1:7621"
+
+    def __post_init__(self) -> None:
+        if self.n < 2:
+            raise ValueError(f"n must be >= 2, got {self.n}")
+        if self.packing not in PACKINGS:
+            raise ValueError(f"packing must be one of {PACKINGS}, got {self.packing!r}")
+        if self.backend not in BACKENDS:
+            raise ValueError(f"backend must be one of {BACKENDS}, got {self.backend!r}")
+        if self.n_segments is not None and self.n_segments < 1:
+            raise ValueError("n_segments must be >= 1")
+        if self.segment_values is not None and self.segment_values < 4:
+            raise ValueError("segment_values must be >= 4")
+        if self.workers < 1:
+            raise ValueError("workers must be >= 1")
+
+    @property
+    def seed_limit(self) -> int:
+        return math.isqrt(self.n)
+
+    def resolved_n_segments(self) -> int:
+        """Segment count after resolving n_segments/segment_values defaults."""
+        if self.n_segments is not None:
+            return self.n_segments
+        if self.segment_values is not None:
+            span = self.n - 1  # values in [2, n+1)
+            return max(1, -(-span // self.segment_values))
+        return 1
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "SieveConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+    def config_hash(self) -> str:
+        """Stable hash of the result-affecting fields (checkpoint ledger key).
+
+        Deliberately excludes backend/workers/observability fields: a resume
+        may switch backends, the math must not change (SURVEY.md section 5.4).
+        """
+        payload = {
+            "n": self.n,
+            "packing": self.packing,
+            "n_segments": self.resolved_n_segments(),
+            "segment_values": self.segment_values,
+            "twins": self.twins,
+        }
+        blob = json.dumps(payload, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]

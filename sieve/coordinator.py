@@ -1,0 +1,152 @@
+"""Coordinator (control plane): seed sieve, partition, dispatch, merge.
+
+SURVEY.md section 1a L4: the coordinator computes seed primes once on the
+host, cuts [2, n+1) into contiguous segments, hands them to workers through
+the SieveWorker boundary, tracks completion, and merges per-segment counts
+plus boundary bitwords into the final result. ``merge_results`` is a
+standalone pure function so the TPU mesh path can reuse the identical merge
+semantics (the north-star requires the merge step "unchanged at the API
+surface", BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Iterable
+
+import numpy as np
+
+from sieve.bitset import get_layout
+from sieve.checkpoint import Ledger
+from sieve.config import SieveConfig
+from sieve.metrics import MetricsLogger
+from sieve.seed import seed_primes
+from sieve.segments import Segment, plan_segments, validate_plan
+from sieve.twins import straddle_twins
+from sieve.worker import SegmentResult, SieveWorker
+
+
+@dataclasses.dataclass
+class SieveResult:
+    n: int
+    pi: int
+    twin_pairs: int | None
+    backend: str
+    packing: str
+    n_segments: int
+    elapsed_s: float
+    values_per_sec: float
+    segments: list[SegmentResult] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["segments"] = [s.to_dict() for s in self.segments]
+        return d
+
+
+def merge_results(
+    config: SieveConfig, results: Iterable[SegmentResult]
+) -> tuple[int, int | None]:
+    """Merge per-segment results into (pi, twin_pairs).
+
+    Validates that the results tile [2, n+1) exactly, sums counts, and
+    resolves cross-boundary twin pairs from boundary bitwords.
+    """
+    layout = get_layout(config.packing)
+    segs = sorted(results, key=lambda r: r.lo)
+    if not segs:
+        raise ValueError("no segment results to merge")
+    if segs[0].lo != 2 or segs[-1].hi != config.n + 1:
+        raise ValueError(
+            f"results cover [{segs[0].lo}, {segs[-1].hi}), "
+            f"expected [2, {config.n + 1})"
+        )
+    for a, b in zip(segs, segs[1:]):
+        if a.hi != b.lo:
+            raise ValueError(f"results gap/overlap at {a.hi} vs {b.lo}")
+    pi = sum(r.count for r in segs)
+    twins: int | None = None
+    if config.twins:
+        twins = sum(r.twin_count for r in segs)
+        for a, b in zip(segs, segs[1:]):
+            twins += straddle_twins(layout, a, b, config.n)
+    return pi, twins
+
+
+class Coordinator:
+    """Single-process coordinator: runs segments through one worker.
+
+    The distributed CPU-cluster coordinator (sieve/cluster.py) and the TPU
+    mesh path (sieve/parallel/mesh.py) reuse plan_segments + merge_results;
+    this class is the degenerate local form (SURVEY.md section 3.1).
+    """
+
+    def __init__(
+        self,
+        config: SieveConfig,
+        worker_factory: Callable[[SieveConfig], SieveWorker] | None = None,
+    ):
+        self.config = config
+        if worker_factory is None:
+            from sieve.backends import make_worker
+
+            worker_factory = make_worker
+        self._worker_factory = worker_factory
+        self.metrics = MetricsLogger(config)
+
+    def plan(self) -> list[Segment]:
+        segs = plan_segments(
+            self.config.n,
+            self.config.resolved_n_segments(),
+            n_workers=self.config.workers,
+        )
+        validate_plan(segs, self.config.n)
+        return segs
+
+    def run(self) -> SieveResult:
+        cfg = self.config
+        t0 = time.perf_counter()
+        seeds = seed_primes(cfg.seed_limit)
+        segs = self.plan()
+
+        ledger = Ledger.open(cfg) if cfg.checkpoint_dir else None
+        done: dict[int, SegmentResult] = {}
+        if ledger is not None and cfg.resume:
+            done = ledger.completed()
+            self.metrics.event("resume", restored=len(done))
+
+        worker = self._worker_factory(cfg)
+        try:
+            for seg in segs:
+                if seg.seg_id in done:
+                    continue
+                res = worker.process_segment(seg.lo, seg.hi, seeds, seg.seg_id)
+                done[seg.seg_id] = res
+                if ledger is not None:
+                    ledger.record(res)
+                self.metrics.segment(res)
+        finally:
+            worker.close()
+
+        results = [done[s.seg_id] for s in segs]
+        pi, twins = merge_results(cfg, results)
+        elapsed = time.perf_counter() - t0
+        result = SieveResult(
+            n=cfg.n,
+            pi=pi,
+            twin_pairs=twins,
+            backend=cfg.backend,
+            packing=cfg.packing,
+            n_segments=len(segs),
+            elapsed_s=elapsed,
+            values_per_sec=(cfg.n - 1) / elapsed if elapsed > 0 else float("inf"),
+            segments=results,
+        )
+        self.metrics.run_summary(result)
+        return result
+
+
+def run_local(config: SieveConfig) -> SieveResult:
+    """SURVEY.md section 3.1 entry point: single-process run."""
+    return Coordinator(config).run()

@@ -1,0 +1,20 @@
+"""Sieve kernels: host-side marking-spec computation + device marking.
+
+The key TPU-first design decision (SURVEY.md section 7.4): TPUs punish
+scatter, so ``mark_multiples`` is reformulated scatter-free. For every
+(prime, residue-class) progression the host emits one *marking spec*
+``(m, r, s)`` meaning "clear every flag bit b with b % m == r and b >= s".
+All three packings reduce to this shape:
+
+  - plain/odds: one spec per prime (stride p in bit space),
+  - wheel30:    eight specs per prime (stride 8p, one per residue class).
+
+On device, marking is then a pure vector compare over the bit index —
+`lax.scan` over specs of an elementwise `(b % m == r) & (b >= s)` mask —
+which XLA fuses and tiles onto the VPU. The Pallas kernel keeps the same
+spec contract but loops specs over a VMEM-resident tile to drop HBM traffic.
+"""
+
+from sieve.kernels.specs import marking_specs
+
+__all__ = ["marking_specs"]

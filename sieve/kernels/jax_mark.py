@@ -1,0 +1,155 @@
+"""Device-side `mark_multiples`: tiered, scatter-free, over packed words.
+
+Strategy A of SURVEY.md section 7.4, realized on uint32 words (bit k of
+word w = flag 32w+k) so HBM traffic is 1/32 of a boolean-flags design:
+
+  Tier 1 — small strides (m <= TIER1_MAX): the marking pattern of spec
+  (m, r) is periodic with period lcm(m,32)/32 words. The host pre-builds
+  each pattern *with the segment's phase baked in* (sieve/kernels/specs.py);
+  the device just `jnp.tile`s it to segment length and ANDs it out. Pure
+  vector ops, >= 32 marked bits per op for the primes that carry most of
+  the crossing mass (SURVEY 7.2: half of all crossings come from p < ~40).
+
+  Tier 2 — mid strides (m > TIER1_MAX >= 1024): each spec hits at most one
+  bit per word. For word w the hit bit is t = (r - 32w) mod m when t < 32.
+  The mod is computed WITHOUT integer division (TPUs have none worth
+  using): t = y - m*floor(y * (1/m)) with y = (r - 32w) + K*m >= 0 and the
+  f32 reciprocal's off-by-one fixed by two selects — exact for
+  m > 1024, y < 2^30 (proof sketch: |q_err| <= (y/m)*3*2^-24 < 1).
+
+  Self-mark correction: both tiers deliberately ignore the "start at p^2"
+  bound (every bit below it is a composite already marked by a smaller
+  prime — except the seed prime itself when it lies inside the segment).
+  The host emits (word, mask) pairs re-setting those seed bits; applied
+  with a tiny scatter-max (associative, duplicate-safe).
+
+Counting, twin pairs, and boundary words all happen on the packed words:
+popcount via lax.population_count; twins as popcount(words & shifted &
+pair_mask) where `shifted` splices each word with its right neighbor.
+
+No scatter in the hot path, no dynamic shapes, no data-dependent control
+flow: everything XLA needs to keep the VPU busy.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+TWIN_NONE = 0
+TWIN_PLAIN = 1  # pairs (b, b+2): adjacent candidates differ by 1
+TWIN_ADJ = 2    # pairs (b, b+1): odds layout, adjacent candidates differ by 2
+TWIN_W30 = 3    # pairs (b, b+1) masked to residue indices {2, 4, 7}
+
+TIER1_MAX = 1024      # specs with m <= this become periodic word patterns
+SPEC_BLOCK = 8        # tier-2 specs processed per scan step
+WORD_BUCKET = 8192    # word-count padding granularity (jit cache bound)
+
+_U32 = jnp.uint32
+
+
+def _splice_right(words, shift: int):
+    """words[w] >> shift with the low `shift` bits of words[w+1] spliced in
+    at the top — pairs bit j of word w with flag bit 32w+j+shift."""
+    nxt = jnp.concatenate([words[1:], jnp.zeros((1,), _U32)])
+    return (words >> _U32(shift)) | (nxt & _U32((1 << shift) - 1)) << _U32(32 - shift)
+
+
+def mark_words_impl(
+    Wpad: int,
+    twin_kind: int,
+    periods: tuple[int, ...],
+    nbits,        # int32 scalar (traced)
+    patterns,     # tuple of uint32 arrays, len == len(periods)
+    m2, r2, K2, rcp2, act2,  # tier-2 specs: i32/i32/i32/f32/u32 [S2]
+    corr_idx, corr_mask,  # int32 [C], uint32 [C] self-mark corrections
+    pair_mask,    # uint32 scalar: twin pairability per bit position
+):
+    w = lax.iota(jnp.int32, Wpad)
+    words = jnp.full((Wpad,), 0xFFFFFFFF, _U32)
+
+    # --- tier 1: tiled periodic patterns ---------------------------------
+    for pat, period in zip(patterns, periods):
+        reps = Wpad // period + 1
+        tiled = jnp.tile(pat, reps)[:Wpad]
+        words = words & ~tiled
+
+    # --- tier 2: one-bit-per-word strides, mod-free ----------------------
+    S2 = m2.shape[0]
+    if S2:
+        assert S2 % SPEC_BLOCK == 0
+
+        def body(ws, spec):
+            mm, rr, kk, rc, ac = spec
+            hit = jnp.zeros_like(ws)
+            for i in range(SPEC_BLOCK):
+                y = rr[i] - 32 * w + kk[i] * mm[i]
+                q = jnp.floor(y.astype(jnp.float32) * rc[i]).astype(jnp.int32)
+                t = y - q * mm[i]
+                t = jnp.where(t < 0, t + mm[i], t)
+                t = jnp.where(t >= mm[i], t - mm[i], t)
+                hit = hit | (
+                    jnp.where(
+                        t < 32,
+                        _U32(1) << jnp.minimum(t, 31).astype(_U32),
+                        _U32(0),
+                    )
+                    & ac[i]
+                )
+            return ws & ~hit, None
+
+        blocks = tuple(
+            a.reshape(-1, SPEC_BLOCK) for a in (m2, r2, K2, rcp2, act2)
+        )
+        words, _ = lax.scan(body, words, blocks)
+
+    # --- self-mark correction (seed primes inside the segment) -----------
+    if corr_idx.shape[0]:
+        cur = words[corr_idx]
+        words = words.at[corr_idx].max(cur | corr_mask)
+
+    # --- mask bits beyond nbits ------------------------------------------
+    bits_valid = jnp.clip(nbits - 32 * w, 0, 32)
+    full = bits_valid >= 32
+    part = (_U32(1) << jnp.minimum(bits_valid, 31).astype(_U32)) - _U32(1)
+    words = words & jnp.where(full, _U32(0xFFFFFFFF), part)
+
+    # --- reductions ------------------------------------------------------
+    count = jnp.sum(lax.population_count(words), dtype=jnp.int32)
+    if twin_kind == TWIN_NONE:
+        twins = jnp.int32(0)
+    else:
+        shift = 2 if twin_kind == TWIN_PLAIN else 1
+        adj = words & _splice_right(words, shift) & pair_mask
+        twins = jnp.sum(lax.population_count(adj), dtype=jnp.int32)
+
+    # --- boundary words --------------------------------------------------
+    first_word = words[0]
+    off = nbits - 32
+    wl = off // 32
+    sh = (off % 32).astype(_U32)
+    pair = lax.dynamic_slice(words, (wl,), (2,))
+    spliced = (pair[0] >> sh) | jnp.where(
+        sh == 0, _U32(0), pair[1] << (_U32(32) - sh)
+    )
+    return count, twins, first_word, spliced
+
+
+@functools.partial(
+    jax.jit, static_argnames=("Wpad", "twin_kind", "periods")
+)
+def mark_words(
+    Wpad, twin_kind, periods, nbits, patterns, m2, r2, K2, rcp2, act2,
+    corr_idx, corr_mask, pair_mask,
+):
+    return mark_words_impl(
+        Wpad, twin_kind, periods, nbits, patterns, m2, r2, K2, rcp2, act2,
+        corr_idx, corr_mask, pair_mask,
+    )
+
+
+def next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1)).bit_length()

@@ -1,0 +1,308 @@
+"""Host-side computation of marking specs (numpy-vectorized, O(#primes)).
+
+A spec (m, r, s) over a segment's bit space instructs the device kernel to
+clear flag bits {b : b % m == r, b >= s}. See sieve/kernels/__init__.py for
+why this shape: it makes composite-marking scatter-free on TPU.
+
+The start computation is the classic nest validated in SURVEY.md section
+4.2: start = max(p^2, ceil(lo/p)*p), bumped into the candidate class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from sieve.bitset import WHEEL30_RESIDUES, get_layout
+
+# modular inverses of the units mod 30 (u * inv == 1 mod 30)
+_W30_INV = {1: 1, 7: 13, 11: 11, 13: 7, 17: 23, 19: 19, 23: 17, 29: 29}
+_W30_INV_ARR = np.zeros(30, dtype=np.int64)
+for _u, _v in _W30_INV.items():
+    _W30_INV_ARR[_u] = _v
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecSet:
+    """Marking specs for one segment: clear bits {b % m == r, b >= s}."""
+
+    m: np.ndarray  # int32 [S] moduli (bit-space strides)
+    r: np.ndarray  # int32 [S] residues
+    s: np.ndarray  # int32 [S] start bits
+    nbits: int
+
+    @property
+    def count(self) -> int:
+        return int(self.m.size)
+
+
+def marking_specs(
+    packing: str, lo: int, hi: int, seeds: np.ndarray
+) -> SpecSet:
+    """Specs for marking all composites among candidates of [lo, hi)."""
+    layout = get_layout(packing)
+    nbits = layout.nbits(lo, hi)
+    if nbits >= 2**31:
+        raise ValueError(f"segment too large: {nbits} bits >= 2^31")
+    if nbits == 0:
+        z = np.zeros(0, np.int32)
+        return SpecSet(z, z, z, 0)
+    p = seeds.astype(np.int64)
+    if packing == "plain":
+        p = p[p * p < hi]
+        first = max(lo, 2)
+        start = np.maximum(p * p, -(-lo // p) * p)
+        keep = start < hi
+        p, start = p[keep], start[keep]
+        b0 = start - first
+        m = p
+    elif packing == "odds":
+        p = p[(p > 2) & (p * p < hi)]
+        first = layout.first_candidate(lo)
+        start = np.maximum(p * p, -(-lo // p) * p)
+        start = np.where(start % 2 == 0, start + p, start)
+        keep = start < hi
+        p, start = p[keep], start[keep]
+        b0 = (start - first) // 2
+        m = p
+    elif packing == "wheel30":
+        p = p[(p > 5) & (p * p < hi)]
+        g0 = layout.gidx(layout.first_candidate(lo))
+        pinv = _W30_INV_ARR[p % 30]
+        res = np.array(WHEEL30_RESIDUES, dtype=np.int64)
+        # grid over (prime, residue class): m-class c whose multiples hit r
+        c = (res[None, :] * pinv[:, None]) % 30
+        m_lo = np.maximum(p, -(-lo // p))[:, None]
+        m0 = m_lo + (c - m_lo) % 30
+        v0 = p[:, None] * m0
+        keep = v0 < hi
+        v0k = v0[keep]
+        pk = np.broadcast_to(p[:, None], v0.shape)[keep]
+        gid = 8 * (v0k // 30) + _w30_idx(v0k % 30)
+        b0 = gid - g0
+        m = 8 * pk
+    else:
+        raise ValueError(f"unknown packing {packing!r}")
+    r = b0 % m
+    return SpecSet(
+        m=m.astype(np.int32),
+        r=r.astype(np.int32),
+        s=b0.astype(np.int32),
+        nbits=nbits,
+    )
+
+
+def _w30_idx(res: np.ndarray) -> np.ndarray:
+    from sieve.bitset import _W30_IDX
+
+    return _W30_IDX[res]
+
+
+# ---------------------------------------------------------------------------
+# Tiered preparation for the word kernel (sieve/kernels/jax_mark.py).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TieredSegment:
+    """Everything the word kernel needs for one segment, host-prepared."""
+
+    nbits: int
+    Wpad: int
+    periods: tuple[int, ...]          # static: tier-1 pattern lengths (words)
+    patterns: tuple[np.ndarray, ...]  # uint32, one per period, phase baked in
+    m2: np.ndarray                    # int32 [S2] tier-2 moduli
+    r2: np.ndarray                    # int32 [S2] tier-2 residues
+    K2: np.ndarray                    # int32 [S2] y-offset multipliers
+    rcp2: np.ndarray                  # float32 [S2] 1/m
+    act2: np.ndarray                  # uint32 [S2] 0xFFFFFFFF real / 0 padding
+    corr_idx: np.ndarray              # int32 [C] self-mark correction words
+    corr_mask: np.ndarray             # uint32 [C] bits to re-set
+    pair_mask: int                    # uint32 scalar: twin pairability
+
+    def with_spec_count(self, target: int) -> "TieredSegment":
+        """Re-pad the tier-2 spec arrays to `target` (shape bucketing)."""
+        S = self.m2.size
+        if target == S:
+            return self
+        if target < S:
+            raise ValueError(f"cannot shrink {S} specs to {target}")
+        pad = target - S
+        K_pad = -(-32 * self.Wpad // _PAD_M)
+        return dataclasses.replace(
+            self,
+            m2=np.concatenate([self.m2, np.full(pad, _PAD_M, np.int32)]),
+            r2=np.concatenate([self.r2, np.zeros(pad, np.int32)]),
+            K2=np.concatenate([self.K2, np.full(pad, K_pad, np.int32)]),
+            rcp2=np.concatenate(
+                [self.rcp2, np.full(pad, 1.0 / _PAD_M, np.float32)]
+            ),
+            act2=np.concatenate([self.act2, np.zeros(pad, np.uint32)]),
+        )
+
+
+_PAD_M = 1 << 20  # tier-2 padding modulus (inert: act2 == 0 masks its hits)
+
+# Segment-size ceiling for the word kernel: 32*Wpad must stay < 2^30 so the
+# f32 reciprocal-mod error bound in jax_mark.py holds.
+MAX_WORDS = 1 << 25
+
+
+def tier1_specs(
+    packing: str, lo: int, seeds: np.ndarray, tier1_max: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """(m, r) for every small-stride prime, *unconditionally* (no p^2 < hi
+    cut): the periodic pattern of a prime with no crossings in the segment
+    marks nothing in range (its residue class has no candidate members
+    there), so including it is harmless — and it keeps the static `periods`
+    tuple identical across all shards of a run, which is what lets every
+    mesh shard share one compiled kernel."""
+    layout = get_layout(packing)
+    f = layout.first_candidate(lo)
+    p = seeds.astype(np.int64)
+    if packing == "plain":
+        p = p[p <= tier1_max]
+        m = p
+        r = (p - f % p) % p  # f + b == 0 (mod p)
+    elif packing == "odds":
+        p = p[(p > 2) & (p <= tier1_max)]
+        m = p
+        inv2 = (p + 1) // 2
+        r = ((p - f % p) % p) * inv2 % p  # f + 2b == 0 (mod p)
+    elif packing == "wheel30":
+        p = p[(p > 5) & (8 * p <= tier1_max)]
+        g0 = layout.gidx(f)
+        pinv = _W30_INV_ARR[p % 30]
+        res = np.array(WHEEL30_RESIDUES, dtype=np.int64)
+        c = (res[None, :] * pinv[:, None]) % 30
+        m_lo = np.maximum(1, -(-lo // p))[:, None]
+        m0 = m_lo + (c - m_lo) % 30
+        v0 = p[:, None] * m0  # smallest candidate multiple >= lo, per class
+        gid = 8 * (v0 // 30) + _w30_idx(v0 % 30)
+        b0 = (gid - g0).ravel()
+        m = np.repeat(8 * p, 8)
+        r = b0 % m
+    else:
+        raise ValueError(f"unknown packing {packing!r}")
+    return m, r
+
+
+def _tier1_patterns(
+    m: np.ndarray, r: np.ndarray
+) -> tuple[tuple[int, ...], tuple[np.ndarray, ...]]:
+    """Periodic word patterns (marks=1) for small-stride specs, merged by
+    period. Pattern word w covers bits [32w, 32w+32) of a buffer that tiles
+    the segment exactly because 32*period == lcm(m, 32) == 0 (mod m)."""
+    by_period: dict[int, np.ndarray] = {}
+    for mi, ri in zip(m.tolist(), r.tolist()):
+        period = mi // np.gcd(mi, 32)
+        bits = np.zeros(32 * period, dtype=bool)
+        bits[ri % mi :: mi] = True
+        pat = np.packbits(bits, bitorder="little").view("<u4")
+        if period in by_period:
+            by_period[period] = by_period[period] | pat
+        else:
+            by_period[period] = pat
+    periods = tuple(sorted(by_period))
+    return periods, tuple(by_period[p] for p in periods)
+
+
+def _corrections(
+    packing: str, lo: int, hi: int, seeds: np.ndarray, pad_to: int = 32
+) -> tuple[np.ndarray, np.ndarray]:
+    """(word_idx, bitmask) pairs re-setting seed primes' own bits — the only
+    bits the start-free tiers can wrongly clear (see jax_mark.py docstring).
+    Grouped by word (scatter-max is duplicate-safe, this just shrinks C)."""
+    layout = get_layout(packing)
+    p = seeds[(seeds >= lo) & (seeds < hi)]
+    for wp in layout.wheel_primes:
+        p = p[p != wp]
+    if p.size:
+        g0 = layout.gidx(layout.first_candidate(lo))
+        bits = layout.gidx_np(p) - g0
+        words = (bits // 32).astype(np.int64)
+        masks = np.uint32(1) << (bits % 32).astype(np.uint32)
+        uniq = np.unique(words)
+        merged = np.zeros(uniq.size, dtype=np.uint32)
+        for i, u in enumerate(uniq):
+            merged[i] = np.bitwise_or.reduce(masks[words == u])
+        idx, msk = uniq.astype(np.int32), merged
+    else:
+        idx = np.zeros(0, np.int32)
+        msk = np.zeros(0, np.uint32)
+    C = max(pad_to, -(-idx.size // pad_to) * pad_to)
+    pad = C - idx.size
+    return (
+        np.concatenate([idx, np.zeros(pad, np.int32)]),
+        np.concatenate([msk, np.zeros(pad, np.uint32)]),
+    )
+
+
+def _pair_mask(packing: str, lo: int) -> int:
+    """uint32 mask of bit positions whose (b, b+shift) pair is a twin pair."""
+    if packing != "wheel30":
+        return 0xFFFFFFFF
+    layout = get_layout(packing)
+    g0 = layout.gidx(layout.first_candidate(lo))
+    mask = 0
+    for j in range(32):
+        if (g0 + j) % 8 in (2, 4, 7):  # (11,13), (17,19), (29,31) classes
+            mask |= 1 << j
+    return mask
+
+
+def prepare_tiered(
+    packing: str,
+    lo: int,
+    hi: int,
+    seeds: np.ndarray,
+    tier1_max: int,
+    spec_block: int,
+    word_bucket: int,
+) -> TieredSegment:
+    """Host-side preparation of one segment for the word kernel."""
+    specs = marking_specs(packing, lo, hi, seeds)
+    nbits = specs.nbits
+    W = -(-nbits // 32)
+    Wpad = -(-(W + 1) // word_bucket) * word_bucket
+    if Wpad > MAX_WORDS:
+        raise ValueError(
+            f"segment too large for word kernel: {nbits} bits "
+            f"(> {32 * MAX_WORDS}); use more segments/rounds"
+        )
+
+    t1m, t1r = tier1_specs(packing, lo, seeds, tier1_max)
+    periods, patterns = _tier1_patterns(t1m, t1r)
+
+    big = specs.m > tier1_max
+    m2 = specs.m[big].astype(np.int64)
+    r2 = specs.r[big].astype(np.int64)
+    S2 = int(m2.size)
+    S2p = max(spec_block, -(-S2 // spec_block) * spec_block)
+    pad = S2p - S2
+    m2 = np.concatenate([m2, np.full(pad, _PAD_M, np.int64)])
+    r2 = np.concatenate([r2, np.zeros(pad, np.int64)])
+    act2 = np.concatenate(
+        [np.full(S2, 0xFFFFFFFF, np.uint32), np.zeros(pad, np.uint32)]
+    )
+    K2 = -(-32 * Wpad // m2)
+    rcp2 = (1.0 / m2).astype(np.float32)
+
+    corr_idx, corr_mask = _corrections(packing, lo, hi, seeds)
+    return TieredSegment(
+        nbits=nbits,
+        Wpad=Wpad,
+        periods=periods,
+        patterns=patterns,
+        m2=m2.astype(np.int32),
+        r2=r2.astype(np.int32),
+        K2=K2.astype(np.int32),
+        rcp2=rcp2,
+        act2=act2,
+        corr_idx=corr_idx,
+        corr_mask=corr_mask,
+        pair_mask=_pair_mask(packing, lo),
+    )
+
+

@@ -1,0 +1,66 @@
+"""Metrics / structured logging (SURVEY.md section 5.5).
+
+One JSON line per segment (id, owner, lo, hi, ms, count) plus an end-of-run
+summary carrying the north-star metric, primes/sec/chip. ``--quiet``
+suppresses per-segment lines; ``--json`` makes the final result a single
+machine-readable line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import TYPE_CHECKING, Any, TextIO
+
+if TYPE_CHECKING:
+    from sieve.config import SieveConfig
+    from sieve.coordinator import SieveResult
+    from sieve.worker import SegmentResult
+
+
+class MetricsLogger:
+    def __init__(self, config: "SieveConfig", stream: TextIO | None = None):
+        self.config = config
+        self.stream = stream if stream is not None else sys.stderr
+        self.t_start = time.time()
+
+    def _emit(self, record: dict[str, Any]) -> None:
+        if self.config.quiet:
+            return
+        record.setdefault("ts", round(time.time() - self.t_start, 4))
+        self.stream.write(json.dumps(record) + "\n")
+        self.stream.flush()
+
+    def event(self, kind: str, **fields: Any) -> None:
+        self._emit({"event": kind, **fields})
+
+    def segment(self, res: "SegmentResult") -> None:
+        self._emit(
+            {
+                "event": "segment",
+                "id": res.seg_id,
+                "lo": res.lo,
+                "hi": res.hi,
+                "ms": round(res.elapsed_s * 1000, 3),
+                "count": res.count,
+            }
+        )
+
+    def run_summary(self, result: "SieveResult") -> None:
+        chips = max(1, self.config.workers)
+        self._emit(
+            {
+                "event": "run",
+                "n": result.n,
+                "pi": result.pi,
+                "twins": result.twin_pairs,
+                "backend": result.backend,
+                "packing": result.packing,
+                "elapsed_s": round(result.elapsed_s, 4),
+                "values_per_sec": round(result.values_per_sec, 1),
+                "primes_per_sec_per_chip": round(result.pi / result.elapsed_s / chips, 1)
+                if result.elapsed_s > 0
+                else None,
+            }
+        )

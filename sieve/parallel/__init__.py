@@ -1,0 +1,1 @@
+"""Multi-device execution: segment ownership as a jax.sharding.Mesh axis."""

@@ -1,0 +1,302 @@
+"""TPU mesh path: segment assignment == mesh sharding (SURVEY.md section 3.3).
+
+One XLA dispatch runs a whole round: each device owns one contiguous
+bit-packed segment of [2, n+1), per-segment marking specs ride in sharded
+over the 'seg' axis, counts merge with ``lax.psum`` and boundary flag
+words are exchanged with ``lax.ppermute`` over ICI. The host then builds
+ordinary SegmentResults and reuses the *identical* ``merge_results`` the
+CPU coordinator uses — the north-star's "merge step unchanged at the API
+surface" (BASELINE.json).
+
+Rounds (``--rounds k``) split the run into k sequential dispatches of one
+segment per device each: the failure-recovery / beyond-HBM streaming
+granularity of SURVEY.md sections 5.3 and 5.7. All rounds share one
+compiled step (tier-1 period set is hi-independent by construction, and
+every other shape is bucketed).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+
+import numpy as np
+
+from sieve.backends.jax_backend import TWIN_KIND
+from sieve.bitset import get_layout
+from sieve.checkpoint import Ledger
+from sieve.config import SieveConfig
+from sieve.coordinator import SieveResult, merge_results
+from sieve.kernels.jax_mark import (
+    SPEC_BLOCK,
+    TIER1_MAX,
+    TWIN_NONE,
+    WORD_BUCKET,
+    mark_words_impl,
+    next_pow2,
+)
+from sieve.kernels.specs import prepare_tiered
+from sieve.metrics import MetricsLogger
+from sieve.seed import seed_primes
+from sieve.segments import plan_segments, validate_plan
+from sieve.worker import SegmentResult
+
+MIN_SHARD_BITS = 64
+
+
+def _shard_map():
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map  # older jax
+
+    return shard_map
+
+
+def build_mesh(n_devices: int):
+    """Mesh over the 'seg' axis. Honors SIEVE_JAX_PLATFORM; falls back to
+    the (virtual) CPU devices when the default platform is too small, so
+    multi-chip logic is exercisable on a single-chip host (SURVEY 4.2)."""
+    import jax
+
+    platform = os.environ.get("SIEVE_JAX_PLATFORM")
+    devices = jax.devices(platform) if platform else jax.devices()
+    if len(devices) < n_devices:
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= n_devices:
+            devices = cpu
+        else:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)} "
+                f"(cpu fallback has {len(cpu)}; set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices})"
+            )
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(devices[:n_devices]), ("seg",))
+
+
+_MESHES: dict = {}
+
+
+def _register_mesh(mesh) -> tuple:
+    key = tuple(d.id for d in mesh.devices.flat)
+    _MESHES[key] = mesh
+    return key
+
+
+@functools.lru_cache(maxsize=None)
+def _make_step(mesh_key, Wpad: int, twin_kind: int, periods: tuple, ndev: int):
+    """Jitted one-round step over a fixed mesh; cached per shape bucket."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _MESHES[mesh_key]
+    smap = _shard_map()
+
+    def shard_fn(nbits, patterns, m2, r2, K2, rcp2, act2,
+                 ci, cm, pmask, gap_ok):
+        count, twins, first32, last32 = mark_words_impl(
+            Wpad, twin_kind, periods, nbits[0],
+            tuple(p[0] for p in patterns),
+            m2[0], r2[0], K2[0], rcp2[0], act2[0],
+            ci[0], cm[0], pmask[0],
+        )
+        # --- ICI collectives (the TPU 'transport' layer) -------------------
+        total = lax.psum(count, "seg")
+        # left-neighbor exchange of the first flag bit for the on-device
+        # odds straddle count (the host merge recomputes this exactly for
+        # every packing; the psum'd value cross-checks the collective path)
+        first_bit = (first32 & jnp.uint32(1)).astype(jnp.int32)
+        recv = lax.ppermute(
+            first_bit, "seg", perm=[(i, i - 1) for i in range(1, ndev)]
+        )
+        last_bit = (last32 >> jnp.uint32(31)).astype(jnp.int32)
+        straddle = last_bit * recv * gap_ok[0]
+        total_twins = lax.psum(twins + straddle, "seg")
+        return (
+            total,
+            total_twins,
+            count[None],
+            twins[None],
+            first32[None],
+            last32[None],
+        )
+
+    n_pat = len(periods)
+    in_specs = (
+        P("seg"),                    # nbits
+        (P("seg"),) * n_pat,         # patterns
+        P("seg"), P("seg"), P("seg"), P("seg"), P("seg"),  # tier-2
+        P("seg"), P("seg"),          # corrections
+        P("seg"), P("seg"),          # pair_mask, gap_ok
+    )
+    out_specs = (P(), P(), P("seg"), P("seg"), P("seg"), P("seg"))
+    try:
+        sharded = smap(
+            shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:  # older jax spells the replication check differently
+        sharded = smap(
+            shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+    return jax.jit(sharded)
+
+
+def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
+    """Run the sieve sharded over a device mesh, one segment per device per
+    round. Falls back to the local coordinator for ranges too small to
+    shard meaningfully."""
+    cfg = config
+    metrics = MetricsLogger(cfg)
+    t0 = time.perf_counter()
+    ndev = cfg.workers
+    if mesh is None:
+        mesh = build_mesh(ndev)
+    else:
+        ndev = int(np.prod(mesh.devices.shape))
+    mesh_key = _register_mesh(mesh)
+
+    n_segs = ndev * max(1, cfg.rounds)
+    if cfg.n_segments is not None and cfg.n_segments != n_segs:
+        raise ValueError(
+            f"mesh path segments by workers*rounds = {n_segs}; "
+            f"--segments {cfg.n_segments} conflicts (drop it or match)"
+        )
+    segs = plan_segments(cfg.n, n_segs)
+    layout = get_layout(cfg.packing)
+    if len(segs) != n_segs or any(
+        layout.nbits(s.lo, s.hi) < MIN_SHARD_BITS for s in segs
+    ):
+        from sieve.coordinator import run_local
+
+        small = SieveConfig(**{**cfg.to_dict(), "backend": "jax", "workers": 1})
+        return run_local(small)
+    validate_plan(segs, cfg.n)
+    # the ledger must describe the segmentation actually used, so a resume
+    # with different workers/rounds (or the CPU coordinator's default plan)
+    # is refused by the config-hash guard rather than mis-merged
+    cfg = SieveConfig(**{**cfg.to_dict(), "n_segments": n_segs})
+
+    seeds = seed_primes(cfg.seed_limit)
+    # shared shape buckets across ALL shards and rounds -> one compile
+    prep0 = [
+        prepare_tiered(cfg.packing, s.lo, s.hi, seeds,
+                       tier1_max=TIER1_MAX, spec_block=SPEC_BLOCK,
+                       word_bucket=WORD_BUCKET)
+        for s in segs
+    ]
+    Wpad = max(p.Wpad for p in prep0)
+    S2 = max(SPEC_BLOCK, next_pow2(max(p.m2.size for p in prep0)))
+    C = max(p.corr_idx.size for p in prep0)
+    periods = prep0[0].periods
+    assert all(p.periods == periods for p in prep0), "tier-1 periods diverged"
+    twin_kind = TWIN_KIND[cfg.packing] if cfg.twins else TWIN_NONE
+    step = _make_step(mesh_key, Wpad, twin_kind, periods, ndev)
+
+    def _pad1(a, n, fill=0):
+        if a.size == n:
+            return a
+        return np.concatenate([a, np.full(n - a.size, fill, a.dtype)])
+
+    ledger = Ledger.open(cfg) if cfg.checkpoint_dir else None
+    done: dict[int, SegmentResult] = {}
+    if ledger is not None and cfg.resume:
+        done = ledger.completed()
+        metrics.event("resume", restored=len(done))
+
+    for rnd in range(max(1, cfg.rounds)):
+        batch = segs[rnd * ndev : (rnd + 1) * ndev]
+        if all(s.seg_id in done for s in batch):
+            continue
+        rt0 = time.perf_counter()
+        preps = [prep0[s.seg_id] for s in batch]
+        nbits_v = np.array([p.nbits for p in preps], np.int32)
+        patterns = tuple(
+            np.stack([p.patterns[i] for p in preps])
+            for i in range(len(periods))
+        )
+        m2 = np.stack([_pad1(p.m2, S2, 1 << 20) for p in preps])
+        r2 = np.stack([_pad1(p.r2, S2) for p in preps])
+        K2 = np.stack([_pad1(p.K2, S2, 1) for p in preps])
+        rcp2 = np.stack([_pad1(p.rcp2, S2, np.float32(2.0 ** -20)) for p in preps])
+        act2 = np.stack([_pad1(p.act2, S2) for p in preps])
+        ci = np.stack([_pad1(p.corr_idx, C) for p in preps])
+        cm = np.stack([_pad1(p.corr_mask, C) for p in preps])
+        pmask = np.array([p.pair_mask for p in preps], np.uint32)
+        # gap_ok[d] = 1 iff (last candidate of seg d, first of seg d+1) is a
+        # potential twin pair (values differ by 2) — odds on-device straddle
+        gap_ok = np.zeros(ndev, np.int32)
+        if cfg.packing == "odds" and cfg.twins:
+            for i in range(len(batch) - 1):
+                lv = layout.last_candidate(batch[i].hi)
+                fv = layout.first_candidate(batch[i + 1].lo)
+                if fv - lv == 2 and fv <= cfg.n:
+                    gap_ok[i] = 1
+        total, total_twins, counts, twins_v, fw, lw = step(
+            nbits_v, patterns, m2, r2, K2, rcp2, act2, ci, cm, pmask, gap_ok
+        )
+        counts, twins_v = np.asarray(counts), np.asarray(twins_v)
+        fw, lw = np.asarray(fw), np.asarray(lw)
+        elapsed_round = time.perf_counter() - rt0
+        for i, s in enumerate(batch):
+            res = SegmentResult(
+                seg_id=s.seg_id,
+                lo=s.lo,
+                hi=s.hi,
+                count=int(counts[i]) + layout.extras_in(s.lo, s.hi),
+                twin_count=(
+                    int(twins_v[i]) + layout.extra_twin_pairs(s.lo, s.hi)
+                    if cfg.twins
+                    else 0
+                ),
+                first_word=int(fw[i]),
+                last_word=int(lw[i]),
+                nbits=int(nbits_v[i]),
+                elapsed_s=elapsed_round / ndev,
+            )
+            done[s.seg_id] = res
+            if ledger is not None:
+                ledger.record(res)
+            metrics.segment(res)
+        # cross-check: the ICI-collective totals agree with the host-side
+        # merge semantics (psum for counts; psum + ppermute straddle for the
+        # odds twin path — the one transport this path exists to exercise)
+        assert int(total) == int(counts.sum()), "psum/count mismatch"
+        if cfg.twins and cfg.packing == "odds":
+            from sieve.twins import straddle_twins
+
+            batch_res = [done[s.seg_id] for s in batch]
+            expect = int(twins_v.sum()) + sum(
+                straddle_twins(layout, a, b, cfg.n)
+                for a, b in zip(batch_res, batch_res[1:])
+            )
+            assert int(total_twins) == expect, (
+                f"ppermute twin path diverged: {int(total_twins)} != {expect}"
+            )
+
+    results = [done[s.seg_id] for s in segs]
+    pi, twin_pairs = merge_results(cfg, results)
+    elapsed = time.perf_counter() - t0
+    result = SieveResult(
+        n=cfg.n,
+        pi=pi,
+        twin_pairs=twin_pairs,
+        backend=cfg.backend,
+        packing=cfg.packing,
+        n_segments=len(segs),
+        elapsed_s=elapsed,
+        values_per_sec=(cfg.n - 1) / elapsed if elapsed > 0 else float("inf"),
+        segments=results,
+    )
+    metrics.run_summary(result)
+    return result

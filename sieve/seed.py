@@ -1,0 +1,41 @@
+"""Seed-prime sieve: primes <= sqrt(N), computed once on the host.
+
+SURVEY.md section 0: the reference computes seed primes on the host and
+ships them to every worker. For the north-star N=1e12 the seed set is
+pi(1e6) = 78,498 primes (~628 KB as int64) — trivially replicated, so a
+simple numpy sieve is the right tool; no need for segmentation here.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def seed_primes(limit: int) -> np.ndarray:
+    """All primes p <= limit, ascending, as int64.
+
+    Plain (non-segmented) Sieve of Eratosthenes; O(limit) memory as bool.
+    """
+    if limit < 2:
+        return np.zeros(0, dtype=np.int64)
+    flags = np.ones(limit + 1, dtype=bool)
+    flags[:2] = False
+    for p in range(2, math.isqrt(limit) + 1):
+        if flags[p]:
+            flags[p * p :: p] = False
+    return np.nonzero(flags)[0].astype(np.int64)
+
+
+def pi_reference(n: int) -> int:
+    """pi(n) by direct whole-range sieve — test oracle for small n only."""
+    return int(seed_primes(n).size)
+
+
+def twin_reference(n: int) -> int:
+    """Count of twin pairs (p, p+2), p+2 <= n — test oracle for small n."""
+    primes = seed_primes(n)
+    if primes.size < 2:
+        return 0
+    return int(np.count_nonzero(np.diff(primes) == 2))

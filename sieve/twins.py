@@ -1,0 +1,66 @@
+"""Twin-prime counting: in-segment adjacent-bit AND + cross-boundary fix-up.
+
+SURVEY.md section 7.3 (odds layout): in-segment twins are
+popcount(flags & flags >> 1); the cross-boundary case is "(last odd of seg i
+is prime) AND (first odd of seg i+1 is prime) AND their values differ by 2".
+This module implements the general-packing version of that merge-side fix-up
+using only each segment's boundary bitwords — the same 32-bit words the TPU
+path exchanges with ``lax.ppermute``.
+
+A pair (v, v+2) straddles the boundary at hi exactly when v < hi <= v + 2,
+i.e. v in {hi-2, hi-1}. The pair is attributed to the left segment.
+"""
+
+from __future__ import annotations
+
+from sieve.bitset import WORD_BITS, Layout
+from sieve.worker import SegmentResult
+
+_SMALL_PRIMES = {2, 3, 5, 7, 11, 13}
+
+
+def is_prime_from_boundary(layout: Layout, seg: SegmentResult, v: int) -> bool:
+    """Primality of v using only seg's boundary words (v near lo or hi)."""
+    if not (seg.lo <= v < seg.hi):
+        raise ValueError(f"value {v} outside segment [{seg.lo}, {seg.hi})")
+    if v in layout.extra_primes:
+        return True
+    if not layout.is_candidate(v):
+        return False
+    b = layout.bit_of(v, seg.lo)
+    if b < 0 or b >= seg.nbits:
+        return False
+    if b < WORD_BITS:
+        return bool((seg.first_word >> b) & 1)
+    off = b - (seg.nbits - WORD_BITS)
+    if off < 0:
+        raise ValueError(
+            f"value {v} (bit {b}) not within a boundary word of "
+            f"segment [{seg.lo}, {seg.hi}) with nbits={seg.nbits}"
+        )
+    return bool((seg.last_word >> off) & 1)
+
+
+def straddle_twins(
+    layout: Layout, left: SegmentResult, right: SegmentResult, n: int
+) -> int:
+    """Twin pairs (v, v+2) with v in `left`, v+2 in `right` (consecutive)."""
+    if left.hi != right.lo:
+        raise ValueError("segments are not consecutive")
+    hi = left.hi
+    total = 0
+    for v in (hi - 2, hi - 1):
+        w = v + 2
+        if v < left.lo or w < hi or w > n:
+            continue
+        if w >= right.hi:
+            # pair would span beyond the right segment; only possible for
+            # degenerate 1-value segments, which plan_segments never emits
+            raise ValueError(f"segment [{right.lo},{right.hi}) too small for pair fix-up")
+        if w in _SMALL_PRIMES:
+            right_prime = True  # 3/5/7... are prime regardless of packing
+        else:
+            right_prime = is_prime_from_boundary(layout, right, w)
+        if right_prime and is_prime_from_boundary(layout, left, v):
+            total += 1
+    return total

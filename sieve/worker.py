@@ -1,0 +1,72 @@
+"""The ``SieveWorker`` plugin boundary — THE backend-selection seam.
+
+SURVEY.md section 2: every execution backend (cpu-numpy, cpu-native,
+cpu-cluster, jax, tpu-pallas) implements the identical
+``process_segment(lo, hi, seed_primes) -> SegmentResult`` signature and is
+parity-tested pairwise. The TPU backend plugs in through this same boundary,
+"alongside the CPU-cluster path" (BASELINE.json north_star).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+if TYPE_CHECKING:
+    from sieve.config import SieveConfig
+
+
+@dataclasses.dataclass
+class SegmentResult:
+    """Per-segment output merged by the coordinator.
+
+    ``count`` includes the layout's extra primes (2 / 2,3,5) when they fall
+    in [lo, hi). ``twin_count`` counts pairs (v, v+2) with both members in
+    [lo, hi); pairs straddling a segment boundary are reconstructed at merge
+    time from the boundary bitwords (sieve/twins.py).
+    """
+
+    seg_id: int
+    lo: int
+    hi: int
+    count: int
+    twin_count: int
+    first_word: int  # first min(32, nbits) flag bits; bit k = flag[k]
+    last_word: int   # bit k = flag[nbits-32+k] (== first_word when nbits <= 32)
+    nbits: int
+    elapsed_s: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SegmentResult":
+        return cls(**{f.name: d[f.name] for f in dataclasses.fields(cls)})
+
+
+class SieveWorker(abc.ABC):
+    """A backend that sieves one segment at a time.
+
+    Contract: given [lo, hi) and the host-computed seed primes (all primes
+    <= isqrt(n), including 2/3/5 — the backend filters per packing), return
+    the SegmentResult for the configured packing. Must be deterministic and
+    idempotent: re-processing a segment yields an identical result (this is
+    what makes failure-reassignment safe, SURVEY.md section 5.3).
+    """
+
+    name: str = ""
+
+    def __init__(self, config: "SieveConfig"):
+        self.config = config
+
+    @abc.abstractmethod
+    def process_segment(
+        self, lo: int, hi: int, seed_primes: np.ndarray, seg_id: int = 0
+    ) -> SegmentResult:
+        ...
+
+    def close(self) -> None:
+        """Release backend resources (sockets, device buffers)."""

@@ -1,0 +1,25 @@
+"""Test environment: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+SURVEY.md section 4.2 item 4: `--xla_force_host_platform_device_count=8`
+gives an 8-device CPU mesh so shard_map/psum/ppermute logic runs in CI with
+no TPU. Must happen before the first `import jax` anywhere in the test run.
+"""
+
+import os
+import sys
+
+# NOTE: in the axon environment a sitecustomize imports jax at interpreter
+# startup with JAX_PLATFORMS=axon, so flipping env vars here cannot change
+# the default platform. The CPU client initializes lazily, though, so the
+# device-count flag still takes effect, and sieve's jax paths honor
+# SIEVE_JAX_PLATFORM for explicit placement (tests run hermetically on the
+# virtual 8-device CPU mesh either way).
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SIEVE_JAX_PLATFORM", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,24 @@
+"""Ground-truth oracle table, from BASELINE.md (computed 2026-07-29 by two
+independent implementations that agreed exactly; NOT copied from the
+reference — see SURVEY.md section 4.1)."""
+
+PI = {
+    10**5: 9_592,
+    10**6: 78_498,
+    10**7: 664_579,
+    10**8: 5_761_455,
+    10**9: 50_847_534,
+    10**10: 455_052_511,
+    10**11: 4_118_054_813,
+    10**12: 37_607_912_018,
+}
+
+# twin pairs (p, p+2) with p+2 <= N
+TWINS = {
+    10**5: 1_224,
+    10**6: 8_169,
+    10**7: 58_980,
+    10**8: 440_312,
+    10**9: 3_424_506,
+    10**10: 27_412_679,
+}

@@ -1,0 +1,97 @@
+"""Differential backend-parity tests (SURVEY.md section 4.2 item 2).
+
+Every SieveWorker backend x every packing: same (lo, hi, seeds) must give an
+identical SegmentResult. Randomized segments plus the adversarial fixtures.
+Runs on the CPU jax platform (tests/conftest.py).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from sieve.config import SieveConfig
+from sieve.coordinator import run_local
+from sieve.seed import seed_primes
+from tests.oracles import PI, TWINS
+
+PACKINGS = ["plain", "odds", "wheel30"]
+
+
+def _available_backends():
+    backends = ["cpu-numpy", "jax"]
+    try:
+        from sieve.backends.cpu_native import CpuNativeWorker  # noqa: F401
+
+        backends.append("cpu-native")
+    except Exception:
+        pass
+    return backends
+
+
+BACKENDS = _available_backends()
+
+
+def _result(backend, packing, lo, hi, n):
+    from sieve.backends import make_worker
+
+    cfg = SieveConfig(n=n, backend=backend, packing=packing, twins=True, quiet=True)
+    w = make_worker(cfg)
+    seeds = seed_primes(cfg.seed_limit)
+    try:
+        return w.process_segment(lo, hi, seeds)
+    finally:
+        w.close()
+
+
+def _strip(res):
+    d = dataclasses.asdict(res)
+    d.pop("elapsed_s")
+    return d
+
+
+FIXTURES = [
+    # (lo, hi, n) — adversarial per SURVEY 4.2: p^2 at boundary, prime at lo,
+    # twin straddling, segment above sqrt(n), tiny segments
+    (2, 1000, 10**4),
+    (49, 121, 10**4),
+    (121, 290, 10**4),
+    (991, 1009, 10**4),
+    (9000, 10001, 10**4),
+    (2, 130, 10**4),
+    (101, 4000, 10**5),
+    (65536, 70000, 10**5),
+]
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+@pytest.mark.parametrize("backend", [b for b in BACKENDS if b != "cpu-numpy"])
+def test_fixture_parity(backend, packing):
+    for lo, hi, n in FIXTURES:
+        ref = _result("cpu-numpy", packing, lo, hi, n)
+        got = _result(backend, packing, lo, hi, n)
+        assert _strip(got) == _strip(ref), (packing, lo, hi)
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+@pytest.mark.parametrize("backend", [b for b in BACKENDS if b != "cpu-numpy"])
+def test_randomized_parity(backend, packing):
+    rng = np.random.default_rng(7)
+    n = 10**6
+    for _ in range(10):
+        lo = int(rng.integers(2, n - 10))
+        hi = int(rng.integers(lo + 2, min(lo + 200_000, n + 1) + 1))
+        ref = _result("cpu-numpy", packing, lo, hi, n)
+        got = _result(backend, packing, lo, hi, n)
+        assert _strip(got) == _strip(ref), (packing, lo, hi)
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+@pytest.mark.parametrize("backend", [b for b in BACKENDS if b != "cpu-numpy"])
+def test_full_run_oracle(backend, packing):
+    cfg = SieveConfig(
+        n=10**6, backend=backend, packing=packing, n_segments=8, twins=True, quiet=True
+    )
+    res = run_local(cfg)
+    assert res.pi == PI[10**6]
+    assert res.twin_pairs == TWINS[10**6]

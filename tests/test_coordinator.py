@@ -1,0 +1,78 @@
+"""Integration: coordinator + cpu-numpy backend against the oracle table,
+all packings, with twins and cross-boundary fix-ups (SURVEY.md section 4.2
+items 2-3)."""
+
+import numpy as np
+import pytest
+
+from sieve.config import SieveConfig
+from sieve.coordinator import run_local
+from sieve.seed import seed_primes, twin_reference
+from tests.oracles import PI, TWINS
+
+PACKINGS = ["plain", "odds", "wheel30"]
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+@pytest.mark.parametrize("n_segments", [1, 7, 64])
+def test_pi_1e5(packing, n_segments):
+    cfg = SieveConfig(n=10**5, packing=packing, n_segments=n_segments, twins=True, quiet=True)
+    res = run_local(cfg)
+    assert res.pi == PI[10**5]
+    assert res.twin_pairs == TWINS[10**5]
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+def test_pi_1e6(packing):
+    cfg = SieveConfig(n=10**6, packing=packing, n_segments=32, twins=True, quiet=True)
+    res = run_local(cfg)
+    assert res.pi == PI[10**6]
+    assert res.twin_pairs == TWINS[10**6]
+
+
+def test_pi_1e7_config1():
+    # driver config 1: single-process sieve to N=1e7
+    cfg = SieveConfig(n=10**7, packing="odds", n_segments=16, twins=True, quiet=True)
+    res = run_local(cfg)
+    assert res.pi == PI[10**7]
+    assert res.twin_pairs == TWINS[10**7]
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+@pytest.mark.parametrize("n", [100, 101, 102, 103, 120, 7, 5, 4, 3, 2, 29, 30, 31])
+def test_exact_small_n(packing, n):
+    cfg = SieveConfig(n=n, packing=packing, n_segments=3, twins=True, quiet=True)
+    res = run_local(cfg)
+    assert res.pi == seed_primes(n).size
+    assert res.twin_pairs == twin_reference(n)
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+def test_boundary_twin_straddle(packing):
+    """Force segment boundaries that split twin pairs (SURVEY 4.2 fixtures)."""
+    # twins around 101,103 and 107,109 and 137,139: use many tiny segments so
+    # some boundary almost surely splits a pair; verify exactness regardless.
+    for n_segments in [2, 3, 5, 11, 23, 60]:
+        cfg = SieveConfig(n=1000, packing=packing, n_segments=n_segments, twins=True, quiet=True)
+        res = run_local(cfg)
+        assert res.pi == 168
+        assert res.twin_pairs == twin_reference(1000), n_segments
+
+
+def test_segment_results_idempotent():
+    cfg = SieveConfig(n=10**4, packing="odds", n_segments=4, quiet=True)
+    r1 = run_local(cfg)
+    r2 = run_local(cfg)
+    for a, b in zip(r1.segments, r2.segments):
+        a_d, b_d = a.to_dict(), b.to_dict()
+        a_d.pop("elapsed_s"), b_d.pop("elapsed_s")
+        assert a_d == b_d
+
+
+def test_merge_rejects_gaps():
+    from sieve.coordinator import merge_results
+
+    cfg = SieveConfig(n=10**4, packing="odds", n_segments=4, quiet=True)
+    res = run_local(cfg)
+    with pytest.raises(ValueError):
+        merge_results(cfg, res.segments[1:])

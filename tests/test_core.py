@@ -1,0 +1,169 @@
+"""Unit tests: seed sieve, layouts (index maps + marking), segment planning.
+
+SURVEY.md section 4.2 item 1: pure math, no devices.
+"""
+
+import numpy as np
+import pytest
+
+from sieve.bitset import (
+    LAYOUTS,
+    WHEEL30_RESIDUES,
+    boundary_words,
+    get_layout,
+    pack_words,
+    popcount_words,
+    unpack_words,
+)
+from sieve.seed import pi_reference, seed_primes, twin_reference
+from sieve.segments import plan_segments, validate_plan
+from tests.oracles import PI, TWINS
+
+
+class TestSeed:
+    def test_small(self):
+        assert seed_primes(1).size == 0
+        assert seed_primes(2).tolist() == [2]
+        assert seed_primes(20).tolist() == [2, 3, 5, 7, 11, 13, 17, 19]
+
+    def test_oracles(self):
+        assert pi_reference(10**5) == PI[10**5]
+        assert pi_reference(10**6) == PI[10**6]
+
+    def test_twin_oracles(self):
+        assert twin_reference(10**5) == TWINS[10**5]
+
+
+class TestLayoutIndexMaps:
+    @pytest.mark.parametrize("name", list(LAYOUTS))
+    def test_gidx_monotone_and_roundtrip(self, name):
+        layout = get_layout(name)
+        lo, hi = 2, 500
+        vals = layout.candidates(lo, hi)
+        g = np.array([layout.gidx(int(v)) for v in vals])
+        # strictly increasing and CONSECUTIVE (no holes in flag space)
+        assert (np.diff(g) == 1).all()
+        assert layout.nbits(lo, hi) == vals.size
+        first = layout.first_candidate(lo)
+        assert first == vals[0]
+        for v in vals[:50]:
+            assert layout.bit_of(int(v), lo) == layout.gidx(int(v)) - layout.gidx(first)
+
+    def test_odds_identity(self):
+        # SURVEY 7.3: bit b of segment at odd lo == value lo + 2b
+        layout = get_layout("odds")
+        lo = 101
+        for b in range(20):
+            assert layout.bit_of(lo + 2 * b, lo) == b
+
+    def test_wheel30_identity(self):
+        # SURVEY 7.3: flag index of v = 8*(v//30) + idx[v%30]
+        layout = get_layout("wheel30")
+        assert layout.gidx(31) == 8 * 1 + 0
+        assert layout.gidx(7) == 1
+        assert layout.gidx(29) == 7
+        assert [layout.gidx(30 + r) for r in WHEEL30_RESIDUES] == list(range(8, 16))
+
+    @pytest.mark.parametrize("name", list(LAYOUTS))
+    @pytest.mark.parametrize("lo", [2, 3, 7, 30, 31, 97, 120])
+    def test_nbits_matches_enumeration(self, name, lo):
+        layout = get_layout(name)
+        for hi in [lo + 1, lo + 2, lo + 7, lo + 30, lo + 101]:
+            assert layout.nbits(lo, hi) == layout.candidates(lo, hi).size
+
+
+def _segment_primes(name, lo, hi, n):
+    """Prime values in [lo, hi) according to a marked segment."""
+    from sieve.backends.cpu_numpy import sieve_segment_flags
+
+    layout = get_layout(name)
+    seeds = seed_primes(int(np.sqrt(n)) + 1)
+    flags = sieve_segment_flags(name, lo, hi, seeds)
+    vals = layout.candidates(lo, hi)
+    found = set(vals[flags[: vals.size]].tolist())
+    found |= {p for p in layout.extra_primes if lo <= p < hi}
+    return found
+
+
+class TestMarking:
+    @pytest.mark.parametrize("name", list(LAYOUTS))
+    def test_whole_range_small(self, name):
+        n = 1000
+        found = _segment_primes(name, 2, n + 1, n)
+        truth = set(seed_primes(n).tolist())
+        assert found == truth
+
+    @pytest.mark.parametrize("name", list(LAYOUTS))
+    @pytest.mark.parametrize(
+        "lo,hi",
+        [
+            (2, 10),        # contains the extra primes
+            (49, 121),      # boundary exactly at p^2 (7^2, 11^2)
+            (97, 98),       # single value, prime
+            (100, 102),     # single candidate, composite region
+            (121, 122),     # p^2 exactly
+            (991, 1009),    # prime at both edges
+            (2, 3),         # just {2}
+            (9973, 10000),  # segment entirely above sqrt(n) for small n
+        ],
+    )
+    def test_adversarial_segments(self, name, lo, hi):
+        n = 10**4
+        truth = {int(p) for p in seed_primes(n) if lo <= p < hi}
+        assert _segment_primes(name, lo, hi, n) == truth
+
+    @pytest.mark.parametrize("name", list(LAYOUTS))
+    def test_randomized_segments(self, name):
+        rng = np.random.default_rng(42)
+        n = 10**5
+        all_primes = seed_primes(n)
+        for _ in range(25):
+            lo = int(rng.integers(2, n - 2))
+            hi = int(rng.integers(lo + 1, min(lo + 5000, n + 1) + 1))
+            truth = {int(p) for p in all_primes if lo <= p < hi}
+            assert _segment_primes(name, lo, hi, n) == truth, (lo, hi)
+
+
+class TestPacking:
+    def test_pack_roundtrip(self):
+        rng = np.random.default_rng(0)
+        for nbits in [1, 31, 32, 33, 64, 100, 1000]:
+            flags = rng.random(nbits) < 0.5
+            words = pack_words(flags)
+            assert words.dtype == np.uint32
+            assert unpack_words(words, nbits).tolist() == flags.tolist()
+            assert popcount_words(words) == int(flags.sum())
+
+    def test_boundary_words(self):
+        rng = np.random.default_rng(1)
+        for nbits in [1, 5, 32, 33, 40, 64, 65, 96, 130]:
+            flags = rng.random(nbits) < 0.5
+            fw, lw = boundary_words(flags)
+            for k in range(min(32, nbits)):
+                assert (fw >> k) & 1 == int(flags[k])
+            if nbits >= 32:
+                for k in range(32):
+                    assert (lw >> k) & 1 == int(flags[nbits - 32 + k])
+            else:
+                assert lw == fw
+
+
+class TestPlanSegments:
+    @pytest.mark.parametrize("n", [10, 100, 10**6, 10**6 + 7])
+    @pytest.mark.parametrize("k", [1, 3, 17, 256])
+    def test_tiling(self, n, k):
+        segs = plan_segments(n, k)
+        validate_plan(segs, n)
+        assert len(segs) <= k
+        assert sum(s.span for s in segs) == n - 1
+
+    def test_owners_round_robin(self):
+        segs = plan_segments(10**5, 16, n_workers=4)
+        assert {s.owner for s in segs} == {0, 1, 2, 3}
+        for s in segs:
+            assert s.owner == s.seg_id % 4
+
+    def test_tiny_range(self):
+        segs = plan_segments(2, 8)
+        validate_plan(segs, 2)
+        assert segs[0].lo == 2 and segs[-1].hi == 3

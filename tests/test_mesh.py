@@ -1,0 +1,83 @@
+"""Distributed-without-a-cluster tests (SURVEY.md section 4.2 item 4):
+shard_map/psum/ppermute logic on the 8-device virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+from sieve.config import SieveConfig
+from sieve.parallel.mesh import build_mesh, run_mesh
+from sieve.seed import seed_primes, twin_reference
+from tests.oracles import PI, TWINS
+
+
+def _n_devices():
+    import jax
+
+    try:
+        return len(jax.devices("cpu"))
+    except RuntimeError:
+        return 0
+
+
+pytestmark = pytest.mark.skipif(
+    _n_devices() < 8, reason="needs the 8-device virtual CPU mesh"
+)
+
+
+@pytest.mark.parametrize("packing", ["plain", "odds", "wheel30"])
+def test_mesh_1e5_8way(packing):
+    cfg = SieveConfig(
+        n=10**5, backend="jax", packing=packing, workers=8, twins=True, quiet=True
+    )
+    res = run_mesh(cfg)
+    assert res.pi == PI[10**5]
+    assert res.twin_pairs == TWINS[10**5]
+    assert res.n_segments == 8
+
+
+@pytest.mark.parametrize("ndev", [2, 4, 8])
+def test_mesh_device_counts(ndev):
+    cfg = SieveConfig(n=10**5, workers=ndev, backend="jax", twins=True, quiet=True)
+    res = run_mesh(cfg)
+    assert res.pi == PI[10**5]
+    assert res.twin_pairs == TWINS[10**5]
+
+
+def test_mesh_rounds_streaming():
+    # rounds > 1: sequential dispatches, one segment per device per round
+    cfg = SieveConfig(
+        n=10**6, workers=4, rounds=4, backend="jax", twins=True, quiet=True
+    )
+    res = run_mesh(cfg)
+    assert res.pi == PI[10**6]
+    assert res.twin_pairs == TWINS[10**6]
+    assert res.n_segments == 16
+
+
+@pytest.mark.parametrize("n", [10**4, 10**4 + 7, 123_456])
+def test_mesh_odd_sizes(n):
+    cfg = SieveConfig(n=n, workers=8, backend="jax", twins=True, quiet=True)
+    res = run_mesh(cfg)
+    assert res.pi == seed_primes(n).size
+    assert res.twin_pairs == twin_reference(n)
+
+
+def test_mesh_tiny_n_falls_back():
+    cfg = SieveConfig(n=200, workers=8, backend="jax", twins=True, quiet=True)
+    res = run_mesh(cfg)
+    assert res.pi == 46
+    assert res.twin_pairs == twin_reference(200)
+
+
+def test_mesh_checkpoint_resume(tmp_path):
+    cfg = SieveConfig(
+        n=10**5, workers=4, rounds=2, backend="jax", twins=True, quiet=True,
+        checkpoint_dir=str(tmp_path),
+    )
+    res1 = run_mesh(cfg)
+    assert res1.pi == PI[10**5]
+    # resume: everything restored from the ledger, no recompute needed
+    cfg2 = SieveConfig(**{**cfg.to_dict(), "resume": True})
+    res2 = run_mesh(cfg2)
+    assert res2.pi == PI[10**5]
+    assert res2.twin_pairs == TWINS[10**5]
