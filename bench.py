@@ -10,8 +10,16 @@ any number is printed: a fast wrong sieve scores zero.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+# persistent XLA compile cache: cuts repeat bench runs from minutes to seconds
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 N = 10**9
 PI_N = 50_847_534  # BASELINE.md oracle (computed, 2026-07-29)
@@ -23,7 +31,8 @@ def main() -> int:
     from sieve.coordinator import run_local
 
     cfg = SieveConfig(
-        n=N, backend="jax", packing="odds", n_segments=4, twins=False, quiet=True
+        n=N, backend="tpu-pallas", packing="odds", n_segments=1, twins=False,
+        quiet=True,
     )
     # warmup: compile every shape bucket once (first TPU compile is slow and
     # is not the thing being measured)
@@ -39,7 +48,7 @@ def main() -> int:
     print(
         json.dumps(
             {
-                "metric": "sieve_throughput_pi_1e9_odds_jax",
+                "metric": "sieve_throughput_pi_1e9_odds_pallas",
                 "value": round(values_per_sec, 1),
                 "unit": "values/s/chip",
                 "vs_baseline": round(values_per_sec / BASELINE_VALUES_PER_SEC, 3),

@@ -1,0 +1,141 @@
+"""cpu-native backend: the C++ mark_multiples hot loop via ctypes.
+
+The reference keeps its hot loop native (SURVEY.md section 0, "On
+implementation language"); this backend is the rebuild's equivalent. Python
+still computes marking specs (control plane); the strided bit-clear,
+popcount, and twin reduction run in csrc/mark_multiples.cc over a packed
+uint64 buffer. Auto-builds the shared library on first use (g++ is baked
+into the image; pybind11 is not, hence ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import time
+from pathlib import Path
+
+import numpy as np
+
+from sieve.bitset import get_layout
+from sieve.worker import SegmentResult, SieveWorker
+
+_CSRC = Path(__file__).resolve().parent.parent.parent / "csrc"
+_LIB: ctypes.CDLL | None = None
+
+
+def _build_and_load() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    name = "libmark_asan.so" if os.environ.get("SIEVE_NATIVE_ASAN") else "libmark.so"
+    so = _CSRC / "build" / name
+    src = _CSRC / "mark_multiples.cc"
+    if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+        import fcntl
+
+        # serialize concurrent auto-builds (cluster workers start together;
+        # two parallel `make`s writing the same .so would let a worker
+        # dlopen a half-written library)
+        so.parent.mkdir(parents=True, exist_ok=True)
+        with open(so.parent / ".build_lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+                target = "asan" if name.endswith("asan.so") else "all"
+                subprocess.run(
+                    ["make", "-C", str(_CSRC), target],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+    lib = ctypes.CDLL(str(so))
+    lib.sieve_init.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+    lib.mark_multiples.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    lib.popcount_words.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.popcount_words.restype = ctypes.c_int64
+    lib.twin_count.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_uint64,
+    ]
+    lib.twin_count.restype = ctypes.c_int64
+    _LIB = lib
+    return lib
+
+
+def _pair_mask64(packing: str, lo: int) -> int:
+    """64-bit twin pairability mask: the 32-bit rule's period (8) divides
+    32, so the wide mask is just the 32-bit helper doubled."""
+    from sieve.kernels.specs import _pair_mask
+
+    m32 = _pair_mask(packing, lo)
+    return m32 | (m32 << 32)
+
+
+def _boundary_words_u64(words: np.ndarray, nbits: int) -> tuple[int, int]:
+    """(first_word, last_word) in SegmentResult's uint32 semantics."""
+    first = int(words[0]) & 0xFFFFFFFF
+    if nbits <= 32:
+        return first, first
+    off = nbits - 32
+    w, sh = divmod(off, 64)
+    val = int(words[w]) >> sh
+    if sh > 32 and w + 1 < words.size:
+        val |= int(words[w + 1]) << (64 - sh)
+    return first, val & 0xFFFFFFFF
+
+
+class CpuNativeWorker(SieveWorker):
+    name = "cpu-native"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._lib = _build_and_load()
+
+    def process_segment(
+        self, lo: int, hi: int, seed_primes: np.ndarray, seg_id: int = 0
+    ) -> SegmentResult:
+        from sieve.kernels.specs import marking_specs
+
+        t0 = time.perf_counter()
+        packing = self.config.packing
+        layout = get_layout(packing)
+        specs = marking_specs(packing, lo, hi, seed_primes)
+        nbits = specs.nbits
+        nwords = max(1, -(-nbits // 64))
+        words = np.empty(nwords, dtype=np.uint64)
+        m = specs.m.astype(np.int64)
+        s = specs.s.astype(np.int64)
+
+        lib = self._lib
+        words_p = words.ctypes.data_as(ctypes.c_void_p)
+        lib.sieve_init(words_p, nwords, nbits)
+        lib.mark_multiples(
+            words_p,
+            nbits,
+            m.ctypes.data_as(ctypes.c_void_p),
+            s.ctypes.data_as(ctypes.c_void_p),
+            len(m),
+        )
+        count = int(lib.popcount_words(words_p, nwords)) + layout.extras_in(lo, hi)
+        twin = 0
+        if self.config.twins and nbits:
+            shift = 2 if packing == "plain" else 1
+            twin = int(
+                lib.twin_count(words_p, nwords, shift, _pair_mask64(packing, lo))
+            )
+            twin += layout.extra_twin_pairs(lo, hi)
+        first_word, last_word = _boundary_words_u64(words, nbits)
+        return SegmentResult(
+            seg_id=seg_id,
+            lo=lo,
+            hi=hi,
+            count=count,
+            twin_count=twin,
+            first_word=first_word,
+            last_word=last_word,
+            nbits=nbits,
+            elapsed_s=time.perf_counter() - t0,
+        )

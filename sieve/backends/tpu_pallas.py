@@ -1,0 +1,74 @@
+"""tpu-pallas backend: the fused single-pass Pallas kernel (SURVEY.md M3).
+
+Same SieveWorker contract and host-side result assembly as the jax
+backend; only the device path differs (sieve/kernels/pallas_mark.py). On
+non-TPU platforms (CI) the kernel runs in Pallas interpret mode, so the
+exact same kernel logic is parity-tested against cpu-numpy without TPU
+hardware.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import numpy as np
+
+from sieve.backends.cpu_numpy import CpuNumpyWorker
+from sieve.backends.jax_backend import MIN_DEVICE_BITS, TWIN_KIND
+from sieve.bitset import get_layout
+from sieve.kernels.jax_mark import TWIN_NONE
+from sieve.kernels.pallas_mark import mark_pallas, prepare_pallas
+from sieve.worker import SegmentResult, SieveWorker
+
+
+class PallasWorker(SieveWorker):
+    name = "tpu-pallas"
+
+    def __init__(self, config):
+        super().__init__(config)
+        import jax
+
+        self._jax = jax
+        platform = os.environ.get("SIEVE_JAX_PLATFORM")
+        self._device = jax.devices(platform)[0] if platform else jax.devices()[0]
+        self._interpret = self._device.platform == "cpu"
+        self._cpu_fallback = CpuNumpyWorker(config)
+
+    def _placement(self):
+        if self._device is None:
+            return contextlib.nullcontext()
+        return self._jax.default_device(self._device)
+
+    def process_segment(
+        self, lo: int, hi: int, seed_primes: np.ndarray, seg_id: int = 0
+    ) -> SegmentResult:
+        t0 = time.perf_counter()
+        packing = self.config.packing
+        layout = get_layout(packing)
+        nbits = layout.nbits(lo, hi)
+        if nbits < MIN_DEVICE_BITS:
+            return self._cpu_fallback.process_segment(lo, hi, seed_primes, seg_id)
+
+        ps = prepare_pallas(packing, lo, hi, seed_primes)
+        twin_kind = TWIN_KIND[packing] if self.config.twins else TWIN_NONE
+        with self._placement():
+            count, twins, first_word, last_word = mark_pallas(
+                ps, twin_kind, self._interpret
+            )
+        count += layout.extras_in(lo, hi)
+        twin_count = (
+            twins + layout.extra_twin_pairs(lo, hi) if self.config.twins else 0
+        )
+        return SegmentResult(
+            seg_id=seg_id,
+            lo=lo,
+            hi=hi,
+            count=count,
+            twin_count=twin_count,
+            first_word=first_word,
+            last_word=last_word,
+            nbits=nbits,
+            elapsed_s=time.perf_counter() - t0,
+        )

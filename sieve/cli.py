@@ -89,6 +89,20 @@ def main(argv: list[str] | None = None) -> int:
 def _run(args: argparse.Namespace) -> int:
     config = config_from_args(args)
 
+    import contextlib
+
+    profile_ctx = contextlib.nullcontext()
+    if config.profile_dir and config.backend in ("jax", "tpu-pallas"):
+        # SURVEY.md section 5.1: wrap the dispatch so the marking kernel
+        # shows up in Perfetto/XProf
+        import jax
+
+        profile_ctx = jax.profiler.trace(config.profile_dir)
+    with profile_ctx:
+        return _dispatch(args, config)
+
+
+def _dispatch(args: argparse.Namespace, config: SieveConfig) -> int:
     if args.role == "worker":
         from sieve.cluster import serve_worker
 
@@ -99,7 +113,14 @@ def _run(args: argparse.Namespace) -> int:
         from sieve.cluster import run_cluster
 
         result = run_cluster(config)
-    elif config.backend in ("jax", "tpu-pallas") and config.workers > 1:
+    elif config.backend == "tpu-pallas" and config.workers > 1:
+        # the mesh path currently runs the XLA word kernel only; refusing is
+        # more honest than silently attributing its numbers to pallas
+        raise ValueError(
+            "multi-worker mesh currently uses the jax word kernel; run "
+            "--backend jax --workers N (pallas-in-mesh is on the roadmap)"
+        )
+    elif config.backend == "jax" and config.workers > 1:
         from sieve.parallel.mesh import run_mesh
 
         result = run_mesh(config)

@@ -1,0 +1,411 @@
+"""cpu-cluster backend: socket transport, worker processes, fault handling.
+
+SURVEY.md section 3.2 — the reference's main distributed path, preserved
+"alongside" the TPU backend (BASELINE.json): a coordinator ships seed
+primes + segment assignments to worker processes over TCP and collects
+per-segment results; control crosses the network exactly twice per segment
+(assign, done). Section 5.3: each assignment carries a deadline refreshed
+by progress heartbeats; a dead or silent worker's segment returns to the
+queue for a different owner. Results are idempotent (keyed on seg_id), so
+double-processing after reassignment cannot double-count.
+
+Wire protocol: 8-byte big-endian length prefix + JSON. Messages:
+  worker -> coordinator: {"type": "hello", "worker_id": i}
+                         {"type": "progress", "seg_id": s}
+                         {"type": "done", "result": SegmentResult dict}
+  coordinator -> worker: {"type": "config", "config": .., "seeds": [..]}
+                         {"type": "assign", "seg_id", "lo", "hi", "chaos_die"}
+                         {"type": "shutdown"}
+
+Fault injection (section 5.3): ``--chaos-kill-worker k@s`` makes worker k
+hard-exit (os._exit) when it receives segment s — exercising detection,
+reassignment, and exact-parity recovery in tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+from sieve.checkpoint import Ledger
+from sieve.config import SieveConfig
+from sieve.coordinator import SieveResult, merge_results
+from sieve.metrics import MetricsLogger
+from sieve.seed import seed_primes
+from sieve.segments import plan_segments, validate_plan
+from sieve.worker import SegmentResult
+
+HEARTBEAT_S = 1.0
+DEADLINE_S = float(os.environ.get("SIEVE_CLUSTER_DEADLINE_S", "60"))
+
+
+# --- framing -----------------------------------------------------------------
+
+
+def send_msg(sock: socket.socket, msg: dict) -> None:
+    blob = json.dumps(msg).encode()
+    sock.sendall(struct.pack(">Q", len(blob)) + blob)
+
+
+def recv_msg(sock: socket.socket) -> dict | None:
+    header = _recv_exact(sock, 8)
+    if header is None:
+        return None
+    (length,) = struct.unpack(">Q", header)
+    blob = _recv_exact(sock, length)
+    if blob is None:
+        return None
+    return json.loads(blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _parse_addr(addr: str) -> tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+# --- worker role -------------------------------------------------------------
+
+
+def serve_worker(config: SieveConfig, worker_id: int | None = None) -> None:
+    """Connect to the coordinator and process assignments until shutdown."""
+    if worker_id is None:
+        worker_id = int(os.environ.get("SIEVE_WORKER_ID", "0"))
+    host, port = _parse_addr(config.coordinator_addr)
+    sock = socket.create_connection((host, port), timeout=30)
+    sock.settimeout(None)
+    send_msg(sock, {"type": "hello", "worker_id": worker_id})
+    msg = recv_msg(sock)
+    assert msg and msg["type"] == "config", f"bad handshake: {msg}"
+    run_cfg = SieveConfig.from_dict(msg["config"])
+    seeds = np.asarray(msg["seeds"], dtype=np.int64)
+
+    from sieve.backends import make_worker
+
+    compute_cfg = SieveConfig.from_dict(
+        {**run_cfg.to_dict(), "backend": _worker_backend()}
+    )
+    worker = make_worker(compute_cfg)
+    try:
+        while True:
+            msg = recv_msg(sock)
+            if msg is None or msg["type"] == "shutdown":
+                return
+            assert msg["type"] == "assign", msg
+            if msg.get("chaos_die"):
+                os._exit(17)  # simulated hard crash, no cleanup
+            result: list[SegmentResult] = []
+            failure: list[str] = []
+
+            def _work(m=msg):
+                try:
+                    if os.environ.get("SIEVE_CHAOS_RAISE") == str(m["seg_id"]):
+                        raise RuntimeError("chaos: injected segment failure")
+                    result.append(
+                        worker.process_segment(m["lo"], m["hi"], seeds, m["seg_id"])
+                    )
+                except Exception as e:  # report, don't die: the coordinator
+                    import traceback     # decides whether to retry or abort
+
+                    failure.append(f"{e!r}\n{traceback.format_exc()}")
+
+            t = threading.Thread(target=_work, daemon=True)
+            t.start()
+            while t.is_alive():
+                t.join(HEARTBEAT_S)
+                if t.is_alive():
+                    send_msg(sock, {"type": "progress", "seg_id": msg["seg_id"]})
+            if failure:
+                send_msg(
+                    sock,
+                    {"type": "error", "seg_id": msg["seg_id"], "error": failure[0]},
+                )
+            else:
+                send_msg(sock, {"type": "done", "result": result[0].to_dict()})
+    finally:
+        worker.close()
+        sock.close()
+
+
+def _worker_backend() -> str:
+    """Compute backend used inside cluster workers: native if it builds."""
+    forced = os.environ.get("SIEVE_CLUSTER_WORKER_BACKEND")
+    if forced:
+        return forced
+    try:
+        from sieve.backends.cpu_native import _build_and_load
+
+        _build_and_load()
+        return "cpu-native"
+    except Exception:
+        return "cpu-numpy"
+
+
+# --- coordinator role --------------------------------------------------------
+
+
+class _WorkerConn(threading.Thread):
+    """One coordinator-side thread per connected worker: assigns segments
+    from the shared queue, enforces the progress deadline, requeues on
+    failure."""
+
+    def __init__(self, cluster: "_Cluster", sock: socket.socket):
+        super().__init__(daemon=True)
+        self.cluster = cluster
+        self.sock = sock
+        self.worker_id = -1
+
+    def run(self) -> None:
+        cl = self.cluster
+        current: tuple[int, int, int] | None = None  # (seg_id, lo, hi)
+        try:
+            hello = recv_msg(self.sock)
+            if not hello or hello["type"] != "hello":
+                return
+            self.worker_id = hello["worker_id"]
+            send_msg(
+                self.sock,
+                {
+                    "type": "config",
+                    "config": cl.config.to_dict(),
+                    "seeds": cl.seeds.tolist(),
+                },
+            )
+            self.sock.settimeout(DEADLINE_S)
+            # keep serving until the whole run is done: a segment requeued by
+            # another worker's failure must find a live owner even if this
+            # thread saw an empty queue earlier
+            while not cl.all_done.is_set():
+                try:
+                    seg = cl.queue.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                if seg.seg_id in cl.done:
+                    continue
+                current = (seg.seg_id, seg.lo, seg.hi)
+                chaos = cl.chaos == (self.worker_id, seg.seg_id)
+                send_msg(
+                    self.sock,
+                    {
+                        "type": "assign",
+                        "seg_id": seg.seg_id,
+                        "lo": seg.lo,
+                        "hi": seg.hi,
+                        "chaos_die": chaos,
+                    },
+                )
+                while True:
+                    msg = recv_msg(self.sock)
+                    if msg is None:
+                        raise ConnectionError("worker closed mid-assignment")
+                    if msg["type"] == "progress":
+                        continue  # deadline refreshed by settimeout per recv
+                    if msg["type"] == "done":
+                        cl.complete(SegmentResult.from_dict(msg["result"]))
+                        current = None
+                        break
+                    if msg["type"] == "error":
+                        cl.segment_error(current, msg["error"])
+                        current = None
+                        break
+                    raise ConnectionError(f"unexpected message {msg['type']}")
+        except (ConnectionError, OSError, socket.timeout) as e:
+            cl.worker_failed(self.worker_id, current, repr(e))
+        finally:
+            try:
+                send_msg(self.sock, {"type": "shutdown"})
+            except OSError:
+                pass
+            self.sock.close()
+
+
+class _Cluster:
+    def __init__(self, config: SieveConfig, seeds, segments, metrics, ledger):
+        self.config = config
+        self.seeds = seeds
+        self.metrics = metrics
+        self.ledger = ledger
+        self.queue: queue.Queue = queue.Queue()
+        self.done: dict[int, SegmentResult] = {}
+        self.lock = threading.Lock()
+        self.n_expected = len(segments)
+        self.all_done = threading.Event()
+        self.attempts: dict[int, int] = {}
+        self.fatal: str | None = None
+        self.chaos: tuple[int, int] | None = None
+        if config.chaos_kill:
+            k, s = config.chaos_kill.split("@")
+            self.chaos = (int(k), int(s))
+        for seg in segments:
+            self.queue.put(seg)
+
+    def complete(self, res: SegmentResult) -> None:
+        with self.lock:
+            if res.seg_id in self.done:
+                return  # idempotent: reassigned segment finished twice
+            self.done[res.seg_id] = res
+            if self.ledger is not None:
+                self.ledger.record(res)
+            self.metrics.segment(res)
+            if len(self.done) >= self.n_expected:
+                self.all_done.set()
+
+    MAX_ATTEMPTS = 4
+
+    def worker_failed(self, worker_id, current, reason: str) -> None:
+        self.metrics.event("worker_failed", worker=worker_id, reason=reason)
+        self._requeue(current, reason)
+
+    def segment_error(self, current, reason: str) -> None:
+        """A worker survived but its segment raised: retry elsewhere, abort
+        the run if the failure looks deterministic (MAX_ATTEMPTS strikes)."""
+        self.metrics.event("segment_error", reason=reason.splitlines()[0])
+        self._requeue(current, reason)
+
+    def _requeue(self, current, reason: str) -> None:
+        if current is None:
+            return
+        seg_id, lo, hi = current
+        with self.lock:
+            if seg_id in self.done:
+                return
+            self.attempts[seg_id] = self.attempts.get(seg_id, 0) + 1
+            if self.attempts[seg_id] >= self.MAX_ATTEMPTS:
+                self.fatal = (
+                    f"segment {seg_id} failed {self.attempts[seg_id]} times; "
+                    f"last error: {reason}"
+                )
+                self.all_done.set()
+                return
+        from sieve.segments import Segment
+
+        self.metrics.event("reassign", seg_id=seg_id)
+        # one-shot chaos: don't re-kill the replacement owner
+        if self.chaos and self.chaos[1] == seg_id:
+            self.chaos = None
+        self.queue.put(Segment(seg_id=seg_id, lo=lo, hi=hi))
+
+
+def run_cluster(config: SieveConfig) -> SieveResult:
+    """Coordinator entry: serve assignments, spawn local workers (unless
+    SIEVE_CLUSTER_NO_SPAWN=1 for externally-launched / multi-host workers),
+    merge results."""
+    cfg = config
+    t0 = time.perf_counter()
+    metrics = MetricsLogger(cfg)
+    seeds = seed_primes(cfg.seed_limit)
+    n_segments = cfg.resolved_n_segments()
+    if cfg.n_segments is None and cfg.segment_values is None:
+        n_segments = max(cfg.workers * 4, 16)  # sensible default for pull model
+    segs = plan_segments(cfg.n, n_segments)
+    validate_plan(segs, cfg.n)
+    eff = SieveConfig(**{**cfg.to_dict(), "n_segments": len(segs)})
+
+    ledger = Ledger.open(eff) if eff.checkpoint_dir else None
+    restored: dict[int, SegmentResult] = {}
+    if ledger is not None and eff.resume:
+        restored = ledger.completed()
+        metrics.event("resume", restored=len(restored))
+
+    todo = [s for s in segs if s.seg_id not in restored]
+    cluster = _Cluster(eff, seeds, todo, metrics, ledger)
+    cluster.done.update(restored)
+    if len(cluster.done) >= len(segs):
+        cluster.n_expected = len(segs)
+        cluster.all_done.set()
+    else:
+        cluster.n_expected = len(segs)
+
+    host, port = _parse_addr(eff.coordinator_addr)
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind((host, port))
+    actual_addr = f"{server.getsockname()[0]}:{server.getsockname()[1]}"
+    server.listen(64)
+    server.settimeout(0.5)
+
+    procs: list[subprocess.Popen] = []
+    if not cluster.all_done.is_set() and not os.environ.get("SIEVE_CLUSTER_NO_SPAWN"):
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for i in range(eff.workers):
+            env = {**os.environ, "SIEVE_WORKER_ID": str(i)}
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "sieve",
+                        "--n", str(eff.n),
+                        "--role", "worker",
+                        "--coordinator-addr", actual_addr,
+                        "--packing", eff.packing,
+                    ]
+                    + (["--twins"] if eff.twins else []),
+                    cwd=repo_root,
+                    env=env,
+                )
+            )
+
+    threads: list[_WorkerConn] = []
+    try:
+        deadline = time.time() + max(DEADLINE_S * 4, 300)
+        while not cluster.all_done.is_set():
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"cluster run timed out with {cluster.n_expected - len(cluster.done)}"
+                    f" segments outstanding"
+                )
+            try:
+                sock, _ = server.accept()
+            except socket.timeout:
+                continue
+            conn = _WorkerConn(cluster, sock)
+            conn.start()
+            threads.append(conn)
+        cluster.all_done.wait()
+    finally:
+        server.close()
+        for t in threads:
+            t.join(timeout=2)
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    if cluster.fatal:
+        raise RuntimeError(f"cluster run aborted: {cluster.fatal}")
+    results = [cluster.done[s.seg_id] for s in segs]
+    pi, twins = merge_results(eff, results)
+    elapsed = time.perf_counter() - t0
+    result = SieveResult(
+        n=eff.n,
+        pi=pi,
+        twin_pairs=twins,
+        backend="cpu-cluster",
+        packing=eff.packing,
+        n_segments=len(segs),
+        elapsed_s=elapsed,
+        values_per_sec=(eff.n - 1) / elapsed if elapsed > 0 else float("inf"),
+        segments=results,
+    )
+    metrics.run_summary(result)
+    return result

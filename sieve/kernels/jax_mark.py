@@ -34,6 +34,7 @@ flow: everything XLA needs to keep the VPU busy.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -44,8 +45,16 @@ TWIN_PLAIN = 1  # pairs (b, b+2): adjacent candidates differ by 1
 TWIN_ADJ = 2    # pairs (b, b+1): odds layout, adjacent candidates differ by 2
 TWIN_W30 = 3    # pairs (b, b+1) masked to residue indices {2, 4, 7}
 
-TIER1_MAX = 1024      # specs with m <= this become periodic word patterns
-SPEC_BLOCK = 8        # tier-2 specs processed per scan step
+# Tuning knobs (env-overridable for microbenchmarking on real hardware):
+# specs with m <= TIER1_MAX become periodic word patterns (each is an
+# unrolled tile+AND op in the graph — the main compile-time cost);
+# SPEC_BLOCK tier-2 specs are processed per scan step.
+# Microbenchmarked on TPU v5e (tools/microbench.py, n=1e9 single segment):
+# TIER1_MAX 1024 -> ~190s compile; 256 -> 147s; 64 -> 5.6s with the best
+# runtime of the three (1.77e9 values/s) — the unrolled pattern ops were
+# nearly all compile cost, and the tier-2 scan handles m in (64, 1024] fine.
+TIER1_MAX = int(os.environ.get("SIEVE_TIER1_MAX", "64"))
+SPEC_BLOCK = int(os.environ.get("SIEVE_SPEC_BLOCK", "16"))
 WORD_BUCKET = 8192    # word-count padding granularity (jit cache bound)
 
 _U32 = jnp.uint32

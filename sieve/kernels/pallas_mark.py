@@ -1,0 +1,338 @@
+"""Pallas `mark_multiples`: the fused single-pass TPU kernel (strategy B).
+
+Where the XLA word kernel (jax_mark.py) makes one pass over the packed
+words per scan step (HBM-bound once specs are few, VPU-bound otherwise),
+this kernel sweeps the segment once: a (R, 128)-word tile lives in
+registers/VMEM while EVERY marking spec, the self-mark corrections, the
+validity mask, popcount, and the twin reduction are applied to it; the
+packed words hit HBM exactly once on the way out. Grid execution on TPU is
+sequential, which this kernel exploits twice: count/twin accumulators are
+revisited SMEM blocks, and the cross-tile twin boundary carries the
+previous tile's last word in SMEM scratch.
+
+Spec groups (host-sorted by bit-stride m, sieve-correct for any segment
+because residue-class marking plus seed self-mark correction is
+start-free — see jax_mark.py's docstring):
+
+  A (m < 32, static unroll): several marked bits per word — two-level
+    exact f32-reciprocal mod to get the first hit t0, then a static
+    16-layer OR of bits t0, t0+m, ... < 32.
+  B (32 <= m <= 1024): one bit per word at most; two-level mod (a single
+    f32 reciprocal is not exact for y/m up to 2^20 when m is small).
+  C (m > 1024): one bit per word; single-level mod (q error < 1/8, fixed
+    by two selects).
+
+All control flow is static or fori_loop with static bounds + act masks:
+no scatter, no gather, no data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from sieve.bitset import get_layout
+from sieve.kernels.specs import _pair_mask, tier1_specs
+
+import os as _os
+
+# Microbenchmarked on TPU v5e (n=1e9): R=64 -> 424ms, 128 -> 416ms,
+# 256 -> 406ms (best), 512 -> 554ms.
+R_ROWS = int(_os.environ.get("SIEVE_PALLAS_ROWS", "256"))  # tile = (R, 128) words
+TILE_WORDS = R_ROWS * 128
+NA_PAD = 16                     # group-A slots (>= 11 primes below 32)
+A_LAYERS = 16                   # max marked bits per word (m=2 -> 16)
+B_MAX = 1024
+_U32 = jnp.uint32
+
+
+@dataclasses.dataclass(frozen=True)
+class PallasSegment:
+    nbits: int
+    Wpad: int                   # padded word count, multiple of TILE_WORDS
+    A: tuple[np.ndarray, ...]   # m, rK, M1, rcp1, rcp, act   each (1, NA_PAD)
+    B: tuple[np.ndarray, ...]   # m, rK, M1, rcp1, rcp, act   each (1, SB)
+    C: tuple[np.ndarray, ...]   # m, rK, rcp, act             each (1, SC)
+    corr_idx: np.ndarray        # (1, CC) int32 global word index (-1 pad)
+    corr_mask: np.ndarray       # (1, CC) uint32
+    pair_mask: int
+
+
+def _group_arrays(m: np.ndarray, r: np.ndarray, Wpad: int, pad_to: int,
+                  two_level: bool) -> tuple[np.ndarray, ...]:
+    """Per-spec constants, padded with inert entries (act = 0)."""
+    S = m.size
+    P = max(pad_to, -(-S // pad_to) * pad_to)
+    K = -(-32 * Wpad // np.maximum(m, 1))
+    rK = r + K * m
+    out_m = np.full(P, 3, np.int32)
+    out_rK = np.zeros(P, np.int32)
+    out_m[:S] = m
+    out_rK[:S] = rK
+    act = np.zeros(P, np.uint32)
+    act[:S] = 0xFFFFFFFF
+    rcp = (1.0 / out_m.astype(np.float64)).astype(np.float32)
+    if two_level:
+        M1 = (out_m.astype(np.int64) << 10).astype(np.int32)
+        rcp1 = (1.0 / (out_m.astype(np.float64) * 1024.0)).astype(np.float32)
+        arrs = (out_m, out_rK, M1, rcp1, rcp, act)
+    else:
+        arrs = (out_m, out_rK, rcp, act)
+    return tuple(a.reshape(1, -1) for a in arrs)
+
+
+def prepare_pallas(packing: str, lo: int, hi: int, seeds: np.ndarray) -> PallasSegment:
+    layout = get_layout(packing)
+    nbits = layout.nbits(lo, hi)
+    W = -(-nbits // 32)
+    Wpad = -(-(W + 1) // TILE_WORDS) * TILE_WORDS
+    if 32 * Wpad >= 1 << 30:
+        raise ValueError(f"segment too large for pallas kernel: {nbits} bits")
+    # start-free residue-class specs for ALL seed primes (see module doc)
+    m, r = tier1_specs(packing, lo, seeds, tier1_max=1 << 62)
+    m = m.astype(np.int64)
+    r = r.astype(np.int64)
+    ga = m < 32
+    gb = (m >= 32) & (m <= B_MAX)
+    gc = m > B_MAX
+    if np.count_nonzero(ga) > NA_PAD:
+        raise ValueError("group A overflow")
+    A = _group_arrays(m[ga], r[ga], Wpad, NA_PAD, two_level=True)
+    B = _group_arrays(m[gb], r[gb], Wpad, 128, two_level=True)
+    C = _group_arrays(m[gc], r[gc], Wpad, 128, two_level=False)
+
+    from sieve.kernels.specs import _corrections
+
+    ci, cm = _corrections(packing, lo, hi, seeds, pad_to=32)
+    ci = ci.astype(np.int64)
+    # _corrections returns bit-word indices for 32-bit words == our words
+    ci_pad = np.full(ci.size, -1, np.int32)
+    real = cm != 0
+    ci_pad[real] = ci[real].astype(np.int32)
+    return PallasSegment(
+        nbits=nbits,
+        Wpad=Wpad,
+        A=A,
+        B=B,
+        C=C,
+        corr_idx=ci_pad.reshape(1, -1),
+        corr_mask=cm.reshape(1, -1),
+        pair_mask=_pair_mask(packing, lo),
+    )
+
+
+def _mod_two_level(y, M1, rcp1, m, rcp):
+    """Exact y mod m for 0 <= y < 2^30 via a 2^10-scaled first reduction."""
+    q1 = jnp.floor(y.astype(jnp.float32) * rcp1).astype(jnp.int32)
+    t1 = y - q1 * M1
+    t1 = jnp.where(t1 < 0, t1 + M1, t1)
+    t1 = jnp.where(t1 >= M1, t1 - M1, t1)
+    q2 = jnp.floor(t1.astype(jnp.float32) * rcp).astype(jnp.int32)
+    t0 = t1 - q2 * m
+    t0 = jnp.where(t0 < 0, t0 + m, t0)
+    t0 = jnp.where(t0 >= m, t0 - m, t0)
+    return t0
+
+
+def _mod_single(y, m, rcp):
+    q = jnp.floor(y.astype(jnp.float32) * rcp).astype(jnp.int32)
+    t = y - q * m
+    t = jnp.where(t < 0, t + m, t)
+    t = jnp.where(t >= m, t - m, t)
+    return t
+
+
+def _onebit(t, act):
+    hit = jnp.where(
+        t < 32, _U32(1) << (t.astype(_U32) & _U32(31)), _U32(0)
+    )
+    return hit & act
+
+
+def _make_kernel(twin_kind: int, SB: int, SC: int, CC: int):
+    shift = 2 if twin_kind == 1 else 1  # TWIN_PLAIN else adjacent
+
+    def kernel(nbits_ref, pmask_ref,
+               Am, ArK, AM1, Arcp1, Arcp, Aact,
+               Bm, BrK, BM1, Brcp1, Brcp, Bact,
+               Cm, CrK, Crcp, Cact,
+               ci_ref, cm_ref,
+               words_ref, count_ref, twin_ref,
+               prev_ref):
+        t = pl.program_id(0)
+        base = t * TILE_WORDS
+        row = lax.broadcasted_iota(jnp.int32, (R_ROWS, 128), 0)
+        lane = lax.broadcasted_iota(jnp.int32, (R_ROWS, 128), 1)
+        w32 = 32 * (base + row * 128 + lane)
+        words = jnp.full((R_ROWS, 128), 0xFFFFFFFF, _U32)
+
+        # --- group A: multi-bit small strides (static unroll) ------------
+        for i in range(NA_PAD):
+            m = Am[0, i]
+            t0 = _mod_two_level(ArK[0, i] - w32, AM1[0, i], Arcp1[0, i],
+                                m, Arcp[0, i])
+            mask = jnp.zeros((R_ROWS, 128), _U32)
+            for k in range(A_LAYERS):
+                bit = t0 + k * m
+                mask = mask | jnp.where(
+                    bit < 32, _U32(1) << (bit.astype(_U32) & _U32(31)), _U32(0)
+                )
+            words = words & ~(mask & Aact[0, i])
+
+        # --- group B: two-level mod, one bit -----------------------------
+        def bbody(i, ws):
+            t0 = _mod_two_level(BrK[0, i] - w32, BM1[0, i], Brcp1[0, i],
+                                Bm[0, i], Brcp[0, i])
+            return ws & ~_onebit(t0, Bact[0, i])
+
+        words = lax.fori_loop(0, SB, bbody, words)
+
+        # --- group C: single-level mod, one bit --------------------------
+        def cbody(i, ws):
+            t0 = _mod_single(CrK[0, i] - w32, Cm[0, i], Crcp[0, i])
+            return ws & ~_onebit(t0, Cact[0, i])
+
+        words = lax.fori_loop(0, SC, cbody, words)
+
+        # --- self-mark corrections (vector compare, no scatter) ----------
+        wg = base + row * 128 + lane
+        corr = jnp.zeros((R_ROWS, 128), _U32)
+        for j in range(CC):
+            corr = corr | jnp.where(wg == ci_ref[0, j], cm_ref[0, j], _U32(0))
+        words = words | corr
+
+        # --- validity mask beyond nbits ----------------------------------
+        nbits = nbits_ref[0, 0]
+        bv = jnp.clip(nbits - w32, 0, 32)
+        full = bv >= 32
+        part = (_U32(1) << (jnp.minimum(bv, 31).astype(_U32))) - _U32(1)
+        words = words & jnp.where(full, _U32(0xFFFFFFFF), part)
+
+        words_ref[:, :] = words
+
+        # --- count -------------------------------------------------------
+        cnt = jnp.sum(lax.population_count(words), dtype=jnp.int32)
+
+        @pl.when(t == 0)
+        def _():
+            count_ref[0, 0] = 0
+            twin_ref[0, 0] = 0
+
+        count_ref[0, 0] += cnt
+
+        # --- twins ---------------------------------------------------
+        if twin_kind:
+            pmask = pmask_ref[0, 0]
+            a = pltpu.roll(words, 127, axis=1)         # lane l+1 (wraps)
+            b = pltpu.roll(a, R_ROWS - 1, axis=0)      # row r+1 of lane 0
+            nxt = jnp.where(lane < 127, a, b)
+            # the tile's very last word has no in-tile successor (roll wraps
+            # to words[0,0]); its cross-word pairs are counted by the
+            # prev/cross mechanism of the NEXT grid step instead
+            is_last = (row == R_ROWS - 1) & (lane == 127)
+            nxt = jnp.where(is_last, _U32(0), nxt)
+            spliced = (words >> _U32(shift)) | (
+                nxt & _U32((1 << shift) - 1)
+            ) << _U32(32 - shift)
+            pairs = words & spliced & pmask
+            tw = jnp.sum(lax.population_count(pairs), dtype=jnp.int32)
+            # cross-tile boundary: last word of the previous tile
+            prev = prev_ref[0, 0]
+            first = words[0, 0]
+            lowbits = _U32((1 << shift) - 1)
+            crossw = (prev >> _U32(32 - shift)) & (first & lowbits) \
+                & (pmask >> _U32(32 - shift))
+            # crossw has at most `shift` (<= 2) bits; Mosaic has no scalar
+            # popcount, so count them arithmetically
+            cross = ((crossw & _U32(1)) + ((crossw >> _U32(1)) & _U32(1))).astype(
+                jnp.int32
+            )
+            tw = tw + jnp.where(t > 0, cross, 0)
+            twin_ref[0, 0] += tw
+            prev_ref[0, 0] = words[R_ROWS - 1, 127]
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call(Wpad: int, twin_kind: int, SB: int, SC: int, CC: int,
+                interpret: bool):
+    kernel = _make_kernel(twin_kind, SB, SC, CC)
+    Wrows = Wpad // 128
+    grid = Wpad // TILE_WORDS
+
+    def smem(n):
+        # per-spec scalars read with dynamic indices -> scalar memory
+        # (Mosaic cannot scalar-load a dynamic lane from VMEM)
+        return pl.BlockSpec((1, n), lambda t: (0, 0), memory_space=pltpu.SMEM)
+
+    smem_scalar = pl.BlockSpec((1, 1), lambda t: (0, 0), memory_space=pltpu.SMEM)
+    in_specs = (
+        [smem_scalar, smem_scalar]
+        + [smem(NA_PAD)] * 6
+        + [smem(SB)] * 6
+        + [smem(SC)] * 4
+        + [smem(CC)] * 2
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((R_ROWS, 128), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            smem_scalar,
+            smem_scalar,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((Wrows, 128), jnp.uint32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        scratch_shapes=[pltpu.SMEM((1, 1), jnp.uint32)],
+        interpret=interpret,
+    )
+    return jax.jit(lambda *args: call(*args))
+
+
+@functools.partial(jax.jit, static_argnames=("Wpad",))
+def _boundary_on_device(Wpad, words_flat, nbits):
+    """first/last 32 flag bits as uint32 scalars — computed on device so the
+    host never pulls the (up to 128 MB) word array over the wire."""
+    first = words_flat[0]
+    off = nbits - 32
+    wl = off // 32
+    sh = (off % 32).astype(_U32)
+    pair = lax.dynamic_slice(words_flat, (wl,), (2,))
+    last = (pair[0] >> sh) | jnp.where(
+        sh == 0, _U32(0), pair[1] << (_U32(32) - sh)
+    )
+    return first, last
+
+
+def mark_pallas(ps: PallasSegment, twin_kind: int, interpret: bool):
+    """Run the fused kernel; returns (count, twins, first_word, last_word).
+
+    The packed words stay on device; only four scalars cross to the host.
+    """
+    SB = ps.B[0].shape[1]
+    SC = ps.C[0].shape[1]
+    CC = ps.corr_idx.shape[1]
+    call = _build_call(ps.Wpad, twin_kind, SB, SC, CC, interpret)
+    words, count, twins = call(
+        np.array([[ps.nbits]], np.int32),
+        np.array([[ps.pair_mask]], np.uint32),
+        *ps.A, *ps.B, *ps.C,
+        ps.corr_idx, ps.corr_mask,
+    )
+    first, last = _boundary_on_device(
+        ps.Wpad, words.reshape(-1), jnp.int32(ps.nbits)
+    )
+    return int(count[0, 0]), int(twins[0, 0]), int(first), int(last)

@@ -19,7 +19,7 @@ PACKINGS = ["plain", "odds", "wheel30"]
 
 
 def _available_backends():
-    backends = ["cpu-numpy", "jax"]
+    backends = ["cpu-numpy", "jax", "tpu-pallas"]  # pallas: interpret mode in CI
     try:
         from sieve.backends.cpu_native import CpuNativeWorker  # noqa: F401
 
@@ -61,6 +61,11 @@ FIXTURES = [
     (2, 130, 10**4),
     (101, 4000, 10**5),
     (65536, 70000, 10**5),
+    # multi-tile for the pallas kernel (one tile = R_ROWS*128*32 bits =
+    # 1,048,576 at the default R_ROWS=256): wheel30 has the fewest bits
+    # (8/30 per value), so n=4e6 guarantees >= 2 tiles for EVERY packing,
+    # exercising the cross-tile twin carry and per-tile accumulators
+    (2, 4 * 10**6 + 1, 4 * 10**6),
 ]
 
 

@@ -1,0 +1,62 @@
+"""cpu-cluster transport tests: workers as local subprocesses (SURVEY.md
+section 4.2 item 4), including the kill-a-worker fault-injection path."""
+
+import numpy as np
+import pytest
+
+from sieve.cluster import run_cluster
+from sieve.config import SieveConfig
+from tests.oracles import PI, TWINS
+
+ADDR = "127.0.0.1:0"  # port 0: the coordinator binds an ephemeral port
+
+
+def _cfg(**kw):
+    base = dict(
+        n=10**5,
+        backend="cpu-cluster",
+        workers=2,
+        n_segments=8,
+        twins=True,
+        quiet=True,
+        coordinator_addr=ADDR,
+    )
+    base.update(kw)
+    return SieveConfig(**base)
+
+
+def test_cluster_basic():
+    res = run_cluster(_cfg())
+    assert res.pi == PI[10**5]
+    assert res.twin_pairs == TWINS[10**5]
+
+
+def test_cluster_three_workers_wheel30():
+    res = run_cluster(_cfg(workers=3, packing="wheel30", n=10**6, n_segments=12))
+    assert res.pi == PI[10**6]
+    assert res.twin_pairs == TWINS[10**6]
+
+
+def test_cluster_chaos_kill_reassigns():
+    # worker 0 hard-exits on segment 2; the run must still be exact
+    res = run_cluster(_cfg(chaos_kill="0@2"))
+    assert res.pi == PI[10**5]
+    assert res.twin_pairs == TWINS[10**5]
+
+
+def test_cluster_deterministic_failure_aborts(monkeypatch):
+    # a segment that raises on every owner must abort the run with the
+    # underlying error after MAX_ATTEMPTS, not hang until the deadline
+    monkeypatch.setenv("SIEVE_CHAOS_RAISE", "3")
+    with pytest.raises(RuntimeError, match="segment 3 failed"):
+        run_cluster(_cfg())
+
+
+def test_cluster_checkpoint_resume(tmp_path):
+    cfg = _cfg(checkpoint_dir=str(tmp_path))
+    res = run_cluster(cfg)
+    assert res.pi == PI[10**5]
+    cfg2 = SieveConfig(**{**cfg.to_dict(), "resume": True})
+    res2 = run_cluster(cfg2)  # fully restored from ledger, no workers needed
+    assert res2.pi == PI[10**5]
+    assert res2.twin_pairs == TWINS[10**5]

@@ -1,0 +1,85 @@
+"""Kernel microbenchmark (SURVEY.md M3 gate): steady-state mark_words
+throughput on the default device for one big segment, separating compile
+time from run time. Tune via SIEVE_TIER1_MAX / SIEVE_SPEC_BLOCK.
+
+Usage: python tools/microbench.py [n] [n_segments]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    use_pallas = "--pallas" in sys.argv
+    n = int(float(args[0])) if args else 10**9
+    n_segments = int(args[1]) if len(args) > 1 else 1
+
+    import jax
+
+    from sieve.backends.jax_backend import TWIN_KIND, prepare_segment
+    from sieve.kernels import jax_mark
+    from sieve.kernels.jax_mark import mark_words
+    from sieve.seed import seed_primes
+    from sieve.segments import plan_segments
+
+    seeds = seed_primes(int(np.sqrt(n)))
+    segs = plan_segments(n, n_segments)
+    seg = segs[0]
+
+    if use_pallas:
+        from sieve.kernels.pallas_mark import mark_pallas, prepare_pallas
+
+        ps = prepare_pallas("odds", seg.lo, seg.hi, seeds)
+        print(
+            f"PALLAS n={n:.0e} segs={n_segments} nbits={ps.nbits} "
+            f"Wpad={ps.Wpad} SB={ps.B[0].shape[1]} SC={ps.C[0].shape[1]}"
+        )
+
+        def call():
+            count, twins, first, last = mark_pallas(ps, TWIN_KIND["odds"], False)
+            return [count, twins]
+
+        ts = ps
+    else:
+        ts = prepare_segment("odds", seg.lo, seg.hi, seeds)
+        print(
+            f"n={n:.0e} segs={n_segments} nbits={ts.nbits} Wpad={ts.Wpad} "
+            f"tier1={len(ts.periods)} patterns (TIER1_MAX={jax_mark.TIER1_MAX}) "
+            f"tier2={ts.m2.size} specs (SPEC_BLOCK={jax_mark.SPEC_BLOCK})"
+        )
+
+        def call():
+            out = mark_words(
+                ts.Wpad, TWIN_KIND["odds"], ts.periods, np.int32(ts.nbits),
+                ts.patterns, ts.m2, ts.r2, ts.K2, ts.rcp2, ts.act2,
+                ts.corr_idx, ts.corr_mask, np.uint32(ts.pair_mask),
+            )
+            return [np.asarray(o) for o in jax.tree.leaves(out)]
+
+    t0 = time.perf_counter()
+    out = call()
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = call()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    bits = ts.nbits
+    print(
+        f"compile={compile_s:.1f}s run(best of 3)={best * 1000:.1f}ms "
+        f"({2 * bits / best:.3e} values/s for this segment) count={out[0]}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    main()
