@@ -19,8 +19,15 @@ start-free — see jax_mark.py's docstring):
     16-layer OR of bits t0, t0+m, ... < 32.
   B (32 <= m <= 1024): one bit per word at most; two-level mod (a single
     f32 reciprocal is not exact for y/m up to 2^20 when m is small).
-  C (m > 1024): one bit per word; single-level mod (q error < 1/8, fixed
-    by two selects).
+  C (1024 < m <= 4096): one bit per word; single-level mod (q error
+    < 1/8, fixed by two selects).
+  D (m > 4096 = one 128-word tile row): at most one bit per ROW, so the
+    mod runs once per (row, spec) instead of once per (word, spec) — 128
+    specs ride the lane dimension of one (R, 128) mod evaluation, and
+    each spec's single hit is placed with a compare against the lane
+    iota. Per-spec per-row cost drops from ~14 vector ops to ~4, and the
+    spec table lives in VMEM behind a fori_loop, so compile time is
+    independent of the spec count (the group that grows with sqrt(N)).
 
 All control flow is static or fori_loop with static bounds + act masks:
 no scatter, no gather, no data-dependent shapes.
@@ -50,6 +57,13 @@ TILE_WORDS = R_ROWS * 128
 NA_PAD = 16                     # group-A slots (>= 11 primes below 32)
 A_LAYERS = 16                   # max marked bits per word (m=2 -> 16)
 B_MAX = 1024
+# Group-D threshold: strides wider than one tile row (128 words * 32 bits)
+# hit each row at most once. Env-overridable for microbenchmarking the C/D
+# split point — only raising it is meaningful (prepare_pallas clamps to the
+# 4096-bit row width, below which the one-hit-per-row invariant breaks);
+# setting it huge routes everything through group C (the pre-D behavior).
+D_MIN = int(_os.environ.get("SIEVE_PALLAS_DMIN", "4096"))
+D_LANES = 128                   # specs per D block (lane dimension)
 _U32 = jnp.uint32
 
 
@@ -60,19 +74,20 @@ class PallasSegment:
     A: tuple[np.ndarray, ...]   # m, rK, M1, rcp1, rcp, act   each (1, NA_PAD)
     B: tuple[np.ndarray, ...]   # m, rK, M1, rcp1, rcp, act   each (1, SB)
     C: tuple[np.ndarray, ...]   # m, rK, rcp, act             each (1, SC)
+    D: tuple[np.ndarray, ...]   # m, rK, rcp, act             each (ND, 128)
     corr_idx: np.ndarray        # (1, CC) int32 global word index (-1 pad)
     corr_mask: np.ndarray       # (1, CC) uint32
     pair_mask: int
 
 
 def _group_arrays(m: np.ndarray, r: np.ndarray, Wpad: int, pad_to: int,
-                  two_level: bool) -> tuple[np.ndarray, ...]:
+                  two_level: bool, pad_m: int = 3) -> tuple[np.ndarray, ...]:
     """Per-spec constants, padded with inert entries (act = 0)."""
     S = m.size
     P = max(pad_to, -(-S // pad_to) * pad_to)
     K = -(-32 * Wpad // np.maximum(m, 1))
     rK = r + K * m
-    out_m = np.full(P, 3, np.int32)
+    out_m = np.full(P, pad_m, np.int32)
     out_rK = np.zeros(P, np.int32)
     out_m[:S] = m
     out_rK[:S] = rK
@@ -88,6 +103,16 @@ def _group_arrays(m: np.ndarray, r: np.ndarray, Wpad: int, pad_to: int,
     return tuple(a.reshape(1, -1) for a in arrs)
 
 
+def _group_d_arrays(m: np.ndarray, r: np.ndarray, Wpad: int) -> tuple[np.ndarray, ...]:
+    """Group-D spec table, (ND, 128)-shaped for VMEM row loads.
+
+    Specs stay sorted by m so a block's strides are similar — hit density
+    per row is uniform within a block, which keeps the placement loop's
+    work per block balanced across tiles."""
+    arrs = _group_arrays(m, r, Wpad, D_LANES, two_level=False, pad_m=1 << 29)
+    return tuple(a.reshape(-1, D_LANES) for a in arrs)
+
+
 def prepare_pallas(packing: str, lo: int, hi: int, seeds: np.ndarray) -> PallasSegment:
     layout = get_layout(packing)
     nbits = layout.nbits(lo, hi)
@@ -99,14 +124,17 @@ def prepare_pallas(packing: str, lo: int, hi: int, seeds: np.ndarray) -> PallasS
     m, r = tier1_specs(packing, lo, seeds, tier1_max=1 << 62)
     m = m.astype(np.int64)
     r = r.astype(np.int64)
+    d_min = max(D_MIN, 4096)  # a D stride must exceed one 4096-bit tile row
     ga = m < 32
     gb = (m >= 32) & (m <= B_MAX)
-    gc = m > B_MAX
+    gc = (m > B_MAX) & (m <= d_min)
+    gd = m > d_min
     if np.count_nonzero(ga) > NA_PAD:
         raise ValueError("group A overflow")
     A = _group_arrays(m[ga], r[ga], Wpad, NA_PAD, two_level=True)
     B = _group_arrays(m[gb], r[gb], Wpad, 128, two_level=True)
     C = _group_arrays(m[gc], r[gc], Wpad, 128, two_level=False)
+    D = _group_d_arrays(m[gd], r[gd], Wpad)
 
     from sieve.kernels.specs import _corrections
 
@@ -122,6 +150,7 @@ def prepare_pallas(packing: str, lo: int, hi: int, seeds: np.ndarray) -> PallasS
         A=A,
         B=B,
         C=C,
+        D=D,
         corr_idx=ci_pad.reshape(1, -1),
         corr_mask=cm.reshape(1, -1),
         pair_mask=_pair_mask(packing, lo),
@@ -156,13 +185,14 @@ def _onebit(t, act):
     return hit & act
 
 
-def _make_kernel(twin_kind: int, SB: int, SC: int, CC: int):
+def _make_kernel(twin_kind: int, SB: int, SC: int, ND: int, CC: int):
     shift = 2 if twin_kind == 1 else 1  # TWIN_PLAIN else adjacent
 
     def kernel(nbits_ref, pmask_ref,
                Am, ArK, AM1, Arcp1, Arcp, Aact,
                Bm, BrK, BM1, Brcp1, Brcp, Bact,
                Cm, CrK, Crcp, Cact,
+               Dm, DrK, Drcp, Dact,
                ci_ref, cm_ref,
                words_ref, count_ref, twin_ref,
                prev_ref):
@@ -200,6 +230,40 @@ def _make_kernel(twin_kind: int, SB: int, SC: int, CC: int):
             return ws & ~_onebit(t0, Cact[0, i])
 
         words = lax.fori_loop(0, SC, cbody, words)
+
+        # --- group D: one bit per tile ROW; 128 specs per mod pass -------
+        if ND:
+            # bit offset of each row's first flag (row r covers bits
+            # [rowbit[r], rowbit[r] + 4096) of the padded segment)
+            rowbit = 32 * (base + row * 128)  # (R, 128); lane-constant
+
+            def dbody(i, ws):
+                mD = Dm[pl.ds(i, 1), :]       # (1, 128): lane s = spec s
+                rKD = DrK[pl.ds(i, 1), :]
+                rcpD = Drcp[pl.ds(i, 1), :]
+                actD = Dact[pl.ds(i, 1), :]
+                # t[r, s] = (rK[s] - rowbit[r]) mod m[s]; hit in row r iff
+                # t < 4096, at word t >> 5, bit t & 31
+                y = rKD - rowbit[:, 0:1]      # (R, 128) via broadcast
+                t0 = _mod_single(y, mD, rcpD)
+                hw = t0 >> 5                  # word-in-row per (row, spec)
+                hmask = jnp.where(
+                    t0 < 4096, _U32(1) << (t0.astype(_U32) & _U32(31)), _U32(0)
+                ) & actD
+                # Placement: the hit of the spec riding lane s belongs at
+                # lane hw[r, s]. Rotating lanes right by k moves lane s to
+                # lane s + k, so the spec's contribution rides rotation
+                # k = (hw - s) mod 128: select it, roll, OR. 128 full-width
+                # rotations, no lane slicing, tiny live state (VMEM-stack
+                # friendly), and compile cost independent of ND.
+                dist = (hw - lane) & 127
+                hit = jnp.zeros((R_ROWS, 128), _U32)
+                for k in range(D_LANES):
+                    contrib = jnp.where(dist == k, hmask, _U32(0))
+                    hit = hit | pltpu.roll(contrib, k, axis=1)
+                return ws & ~hit
+
+            words = lax.fori_loop(0, ND, dbody, words)
 
         # --- self-mark corrections (vector compare, no scatter) ----------
         wg = base + row * 128 + lane
@@ -262,9 +326,9 @@ def _make_kernel(twin_kind: int, SB: int, SC: int, CC: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _build_call(Wpad: int, twin_kind: int, SB: int, SC: int, CC: int,
+def _build_call(Wpad: int, twin_kind: int, SB: int, SC: int, ND: int, CC: int,
                 interpret: bool):
-    kernel = _make_kernel(twin_kind, SB, SC, CC)
+    kernel = _make_kernel(twin_kind, SB, SC, ND, CC)
     Wrows = Wpad // 128
     grid = Wpad // TILE_WORDS
 
@@ -273,12 +337,20 @@ def _build_call(Wpad: int, twin_kind: int, SB: int, SC: int, CC: int,
         # (Mosaic cannot scalar-load a dynamic lane from VMEM)
         return pl.BlockSpec((1, n), lambda t: (0, 0), memory_space=pltpu.SMEM)
 
+    def vmem_rows(nrows):
+        # group-D spec table: whole (ND, 128) array resident in VMEM, rows
+        # loaded with a dynamic sublane index inside the fori_loop
+        return pl.BlockSpec(
+            (nrows, D_LANES), lambda t: (0, 0), memory_space=pltpu.VMEM
+        )
+
     smem_scalar = pl.BlockSpec((1, 1), lambda t: (0, 0), memory_space=pltpu.SMEM)
     in_specs = (
         [smem_scalar, smem_scalar]
         + [smem(NA_PAD)] * 6
         + [smem(SB)] * 6
         + [smem(SC)] * 4
+        + [vmem_rows(max(ND, 1))] * 4
         + [smem(CC)] * 2
     )
     call = pl.pallas_call(
@@ -297,6 +369,11 @@ def _build_call(Wpad: int, twin_kind: int, SB: int, SC: int, CC: int,
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ),
         scratch_shapes=[pltpu.SMEM((1, 1), jnp.uint32)],
+        # group D's unrolled 128-rotation placement keeps more scheduler
+        # temporaries live than the default 16M scoped-VMEM budget allows
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
         interpret=interpret,
     )
     return jax.jit(lambda *args: call(*args))
@@ -324,12 +401,13 @@ def mark_pallas(ps: PallasSegment, twin_kind: int, interpret: bool):
     """
     SB = ps.B[0].shape[1]
     SC = ps.C[0].shape[1]
+    ND = ps.D[0].shape[0] if ps.D[3].any() else 0
     CC = ps.corr_idx.shape[1]
-    call = _build_call(ps.Wpad, twin_kind, SB, SC, CC, interpret)
+    call = _build_call(ps.Wpad, twin_kind, SB, SC, ND, CC, interpret)
     words, count, twins = call(
         np.array([[ps.nbits]], np.int32),
         np.array([[ps.pair_mask]], np.uint32),
-        *ps.A, *ps.B, *ps.C,
+        *ps.A, *ps.B, *ps.C, *ps.D,
         ps.corr_idx, ps.corr_mask,
     )
     first, last = _boundary_on_device(

@@ -91,6 +91,19 @@ def test_randomized_parity(backend, packing):
         assert _strip(got) == _strip(ref), (packing, lo, hi)
 
 
+def test_pallas_group_d_parity():
+    """Group D of the pallas kernel (strides > 4096 bits = one tile row)
+    needs seed primes > 4096, i.e. n > 4096^2 — beyond the other fixtures.
+    One segment at n=3e7 in interpret mode vs the numpy reference (odds
+    only: plain duplicates the same m=p strides and wheel30's m=8p strides
+    already populate D in the n=4e6 fixture)."""
+    n = 30_000_000
+    lo, hi = 2_000_003, 24_000_001  # interior segment: nonzero phase per spec
+    ref = _result("cpu-numpy", "odds", lo, hi, n)
+    got = _result("tpu-pallas", "odds", lo, hi, n)
+    assert _strip(got) == _strip(ref)
+
+
 @pytest.mark.parametrize("packing", PACKINGS)
 @pytest.mark.parametrize("backend", [b for b in BACKENDS if b != "cpu-numpy"])
 def test_full_run_oracle(backend, packing):
