@@ -17,6 +17,21 @@ Architecture (SURVEY.md section 1b):
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# Persistent XLA compile cache for every entry point (CLI, bench, tests):
+# first TPU compile of a shape bucket is tens of seconds, repeats are
+# subsecond. Lives under the user cache dir (never inside the install
+# tree). Opt out by setting JAX_COMPILATION_CACHE_DIR=''.
+_os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    _os.path.join(
+        _os.environ.get("XDG_CACHE_HOME", _os.path.expanduser("~/.cache")),
+        "tpu-sieve", "jax-cache",
+    ),
+)
+_os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
 from sieve.config import SieveConfig
 from sieve.worker import SegmentResult, SieveWorker
 from sieve.coordinator import Coordinator, SieveResult

@@ -113,14 +113,11 @@ def _dispatch(args: argparse.Namespace, config: SieveConfig) -> int:
         from sieve.cluster import run_cluster
 
         result = run_cluster(config)
-    elif config.backend == "tpu-pallas" and config.workers > 1:
-        # the mesh path currently runs the XLA word kernel only; refusing is
-        # more honest than silently attributing its numbers to pallas
-        raise ValueError(
-            "multi-worker mesh currently uses the jax word kernel; run "
-            "--backend jax --workers N (pallas-in-mesh is on the roadmap)"
-        )
-    elif config.backend == "jax" and config.workers > 1:
+    elif config.backend in ("jax", "tpu-pallas") and (
+        config.workers > 1 or config.rounds > 1
+    ):
+        # rounds > 1 on a single device is the streaming path (SURVEY.md
+        # section 5.7): the mesh runner owns round dispatch either way
         from sieve.parallel.mesh import run_mesh
 
         result = run_mesh(config)

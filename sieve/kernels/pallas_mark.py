@@ -113,11 +113,20 @@ def _group_d_arrays(m: np.ndarray, r: np.ndarray, Wpad: int) -> tuple[np.ndarray
     return tuple(a.reshape(-1, D_LANES) for a in arrs)
 
 
-def prepare_pallas(packing: str, lo: int, hi: int, seeds: np.ndarray) -> PallasSegment:
+def prepare_pallas(
+    packing: str, lo: int, hi: int, seeds: np.ndarray, wpad: int | None = None
+) -> PallasSegment:
+    """Host prep for one segment. ``wpad`` overrides the word padding with a
+    larger common value (mesh path: every shard must share one shape; the
+    rK offsets bake in the padding, so it must be fixed before grouping)."""
     layout = get_layout(packing)
     nbits = layout.nbits(lo, hi)
     W = -(-nbits // 32)
     Wpad = -(-(W + 1) // TILE_WORDS) * TILE_WORDS
+    if wpad is not None:
+        if wpad < Wpad or wpad % TILE_WORDS:
+            raise ValueError(f"wpad {wpad} < segment's {Wpad} or unaligned")
+        Wpad = wpad
     if 32 * Wpad >= 1 << 30:
         raise ValueError(f"segment too large for pallas kernel: {nbits} bits")
     # start-free residue-class specs for ALL seed primes (see module doc)
@@ -154,6 +163,54 @@ def prepare_pallas(packing: str, lo: int, hi: int, seeds: np.ndarray) -> PallasS
         corr_idx=ci_pad.reshape(1, -1),
         corr_mask=cm.reshape(1, -1),
         pair_mask=_pair_mask(packing, lo),
+    )
+
+
+def _pad_fills(two_level: bool, pad_m: int) -> tuple:
+    """Inert pad entry per group-array position, derived from the same
+    _group_arrays construction that builds real tables (act = 0 masks every
+    hit; the other values only keep the arithmetic in range)."""
+    z = np.zeros(0, np.int64)
+    arrs = _group_arrays(z, z, 32, 1, two_level=two_level, pad_m=pad_m)
+    return tuple(a[0, 0] for a in arrs)
+
+
+_PAD_B = _pad_fills(two_level=True, pad_m=3)
+_PAD_C = _pad_fills(two_level=False, pad_m=3)
+_PAD_D = _pad_fills(two_level=False, pad_m=1 << 29)
+
+
+def _pad_cols(arrs, fills, target: int):
+    out = []
+    for a, fill in zip(arrs, fills):
+        pad = target - a.shape[1]
+        if pad:
+            ext = np.full((a.shape[0], pad), fill, a.dtype)
+            a = np.concatenate([a, ext], axis=1)
+        out.append(a)
+    return tuple(out)
+
+
+def pad_pallas(ps: PallasSegment, SB: int, SC: int, ND: int, CC: int) -> PallasSegment:
+    """Pad a segment's group tables to common shapes (mesh path: all shards
+    share one compiled kernel, so spec counts must match across shards)."""
+    D = ps.D
+    pad_rows = ND - D[0].shape[0]
+    if pad_rows > 0:
+        D = tuple(
+            np.concatenate(
+                [a, np.full((pad_rows, D_LANES), fill, a.dtype)], axis=0
+            )
+            for a, fill in zip(D, _PAD_D)
+        )
+    ci, cm = _pad_cols((ps.corr_idx, ps.corr_mask), (-1, 0), CC)
+    return dataclasses.replace(
+        ps,
+        B=_pad_cols(ps.B, _PAD_B, SB),
+        C=_pad_cols(ps.C, _PAD_C, SC),
+        D=D,
+        corr_idx=ci,
+        corr_mask=cm,
     )
 
 
@@ -376,6 +433,12 @@ def _build_call(Wpad: int, twin_kind: int, SB: int, SC: int, ND: int, CC: int,
         ),
         interpret=interpret,
     )
+    return call
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call_jit(Wpad, twin_kind, SB, SC, ND, CC, interpret):
+    call = _build_call(Wpad, twin_kind, SB, SC, ND, CC, interpret)
     return jax.jit(lambda *args: call(*args))
 
 
@@ -403,7 +466,7 @@ def mark_pallas(ps: PallasSegment, twin_kind: int, interpret: bool):
     SC = ps.C[0].shape[1]
     ND = ps.D[0].shape[0] if ps.D[3].any() else 0
     CC = ps.corr_idx.shape[1]
-    call = _build_call(ps.Wpad, twin_kind, SB, SC, ND, CC, interpret)
+    call = _build_call_jit(ps.Wpad, twin_kind, SB, SC, ND, CC, interpret)
     words, count, twins = call(
         np.array([[ps.nbits]], np.int32),
         np.array([[ps.pair_mask]], np.uint32),

@@ -8,6 +8,10 @@ ordinary SegmentResults and reuses the *identical* ``merge_results`` the
 CPU coordinator uses — the north-star's "merge step unchanged at the API
 surface" (BASELINE.json).
 
+Two per-shard kernels plug into the same collectives: the XLA word kernel
+(``--backend jax``) and the fused Pallas kernel (``--backend tpu-pallas``,
+interpret mode on CPU meshes so CI covers it without TPU hardware).
+
 Rounds (``--rounds k``) split the run into k sequential dispatches of one
 segment per device each: the failure-recovery / beyond-HBM streaming
 granularity of SURVEY.md sections 5.3 and 5.7. All rounds share one
@@ -90,6 +94,33 @@ def _register_mesh(mesh) -> tuple:
     return key
 
 
+def _collective_merge(count, twins, first32, last32, gap_ok, ndev: int):
+    """ICI collectives shared by both mesh steps (the TPU 'transport'
+    layer): psum count merge; left-neighbor ppermute of the first flag bit
+    for the on-device odds straddle count (the host merge recomputes this
+    exactly for every packing; the psum'd value cross-checks the
+    collective path)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    total = lax.psum(count, "seg")
+    first_bit = (first32 & jnp.uint32(1)).astype(jnp.int32)
+    recv = lax.ppermute(
+        first_bit, "seg", perm=[(i, i - 1) for i in range(1, ndev)]
+    )
+    last_bit = (last32 >> jnp.uint32(31)).astype(jnp.int32)
+    straddle = last_bit * recv * gap_ok[0]
+    total_twins = lax.psum(twins + straddle, "seg")
+    return (
+        total,
+        total_twins,
+        count[None],
+        twins[None],
+        first32[None],
+        last32[None],
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _make_step(mesh_key, Wpad: int, twin_kind: int, periods: tuple, ndev: int):
     """Jitted one-round step over a fixed mesh; cached per shape bucket."""
@@ -109,26 +140,7 @@ def _make_step(mesh_key, Wpad: int, twin_kind: int, periods: tuple, ndev: int):
             m2[0], r2[0], K2[0], rcp2[0], act2[0],
             ci[0], cm[0], pmask[0],
         )
-        # --- ICI collectives (the TPU 'transport' layer) -------------------
-        total = lax.psum(count, "seg")
-        # left-neighbor exchange of the first flag bit for the on-device
-        # odds straddle count (the host merge recomputes this exactly for
-        # every packing; the psum'd value cross-checks the collective path)
-        first_bit = (first32 & jnp.uint32(1)).astype(jnp.int32)
-        recv = lax.ppermute(
-            first_bit, "seg", perm=[(i, i - 1) for i in range(1, ndev)]
-        )
-        last_bit = (last32 >> jnp.uint32(31)).astype(jnp.int32)
-        straddle = last_bit * recv * gap_ok[0]
-        total_twins = lax.psum(twins + straddle, "seg")
-        return (
-            total,
-            total_twins,
-            count[None],
-            twins[None],
-            first32[None],
-            last32[None],
-        )
+        return _collective_merge(count, twins, first32, last32, gap_ok, ndev)
 
     n_pat = len(periods)
     in_specs = (
@@ -139,6 +151,12 @@ def _make_step(mesh_key, Wpad: int, twin_kind: int, periods: tuple, ndev: int):
         P("seg"), P("seg"),          # pair_mask, gap_ok
     )
     out_specs = (P(), P(), P("seg"), P("seg"), P("seg"), P("seg"))
+    return _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs)
+
+
+def _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs):
+    import jax
+
     try:
         sharded = smap(
             shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
@@ -150,6 +168,38 @@ def _make_step(mesh_key, Wpad: int, twin_kind: int, periods: tuple, ndev: int):
             check_rep=False,
         )
     return jax.jit(sharded)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_pallas_step(mesh_key, Wpad: int, twin_kind: int, SB: int, SC: int,
+                      ND: int, CC: int, ndev: int, interpret: bool):
+    """Jitted one-round step running the fused Pallas kernel per shard —
+    the north-star composition (SURVEY.md section 3.3): pallas_call inside
+    shard_map, counts merged with lax.psum and boundary bits exchanged
+    with lax.ppermute over ICI. On CPU meshes the kernel runs in interpret
+    mode, so the multi-chip path is CI-testable without TPU hardware."""
+    from jax.sharding import PartitionSpec as P
+
+    from sieve.kernels.pallas_mark import _boundary_on_device, _build_call
+
+    mesh = _MESHES[mesh_key]
+    smap = _shard_map()
+    call = _build_call(Wpad, twin_kind, SB, SC, ND, CC, interpret)
+
+    def shard_fn(nbits, pmask, *rest):
+        groups = tuple(a[0] for a in rest[:20])   # A(6) + B(6) + C(4) + D(4)
+        ci, cm, gap_ok = rest[20][0], rest[21][0], rest[22]
+        words, count, twins = call(nbits[0], pmask[0], *groups, ci, cm)
+        count = count[0, 0]
+        twins = twins[0, 0]
+        first32, last32 = _boundary_on_device(
+            Wpad, words.reshape(-1), nbits[0, 0, 0]
+        )
+        return _collective_merge(count, twins, first32, last32, gap_ok, ndev)
+
+    in_specs = (P("seg"),) * 25
+    out_specs = (P(), P(), P("seg"), P("seg"), P("seg"), P("seg"))
+    return _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs)
 
 
 def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
@@ -172,14 +222,23 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
             f"mesh path segments by workers*rounds = {n_segs}; "
             f"--segments {cfg.n_segments} conflicts (drop it or match)"
         )
+    if cfg.segment_values is not None:
+        raise ValueError(
+            "mesh path segments by workers*rounds; --segment-size is not "
+            "honored here — use --rounds to control per-dispatch size"
+        )
     segs = plan_segments(cfg.n, n_segs)
     layout = get_layout(cfg.packing)
+    use_pallas = cfg.backend == "tpu-pallas"
     if len(segs) != n_segs or any(
         layout.nbits(s.lo, s.hi) < MIN_SHARD_BITS for s in segs
     ):
         from sieve.coordinator import run_local
 
-        small = SieveConfig(**{**cfg.to_dict(), "backend": "jax", "workers": 1})
+        small_backend = cfg.backend if use_pallas else "jax"
+        small = SieveConfig(
+            **{**cfg.to_dict(), "backend": small_backend, "workers": 1}
+        )
         return run_local(small)
     validate_plan(segs, cfg.n)
     # the ledger must describe the segmentation actually used, so a resume
@@ -188,20 +247,45 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
     cfg = SieveConfig(**{**cfg.to_dict(), "n_segments": n_segs})
 
     seeds = seed_primes(cfg.seed_limit)
-    # shared shape buckets across ALL shards and rounds -> one compile
-    prep0 = [
-        prepare_tiered(cfg.packing, s.lo, s.hi, seeds,
-                       tier1_max=TIER1_MAX, spec_block=SPEC_BLOCK,
-                       word_bucket=WORD_BUCKET)
-        for s in segs
-    ]
-    Wpad = max(p.Wpad for p in prep0)
-    S2 = max(SPEC_BLOCK, next_pow2(max(p.m2.size for p in prep0)))
-    C = max(p.corr_idx.size for p in prep0)
-    periods = prep0[0].periods
-    assert all(p.periods == periods for p in prep0), "tier-1 periods diverged"
     twin_kind = TWIN_KIND[cfg.packing] if cfg.twins else TWIN_NONE
-    step = _make_step(mesh_key, Wpad, twin_kind, periods, ndev)
+    # shared shape buckets across ALL shards and rounds -> one compile
+    if use_pallas:
+        from sieve.kernels.pallas_mark import (
+            TILE_WORDS,
+            pad_pallas,
+            prepare_pallas,
+        )
+
+        Wmax = max(-(-layout.nbits(s.lo, s.hi) // 32) for s in segs)
+        Wpad = -(-(Wmax + 1) // TILE_WORDS) * TILE_WORDS
+        prep0 = [
+            prepare_pallas(cfg.packing, s.lo, s.hi, seeds, wpad=Wpad)
+            for s in segs
+        ]
+        SB = max(p.B[0].shape[1] for p in prep0)
+        SC = max(p.C[0].shape[1] for p in prep0)
+        ND = max(
+            (p.D[0].shape[0] if p.D[3].any() else 0) for p in prep0
+        )
+        CC = max(p.corr_idx.shape[1] for p in prep0)
+        prep0 = [pad_pallas(p, SB, SC, max(ND, 1), CC) for p in prep0]
+        interpret = mesh.devices.flat[0].platform == "cpu"
+        step = _make_pallas_step(
+            mesh_key, Wpad, twin_kind, SB, SC, ND, CC, ndev, interpret
+        )
+    else:
+        prep0 = [
+            prepare_tiered(cfg.packing, s.lo, s.hi, seeds,
+                           tier1_max=TIER1_MAX, spec_block=SPEC_BLOCK,
+                           word_bucket=WORD_BUCKET)
+            for s in segs
+        ]
+        Wpad = max(p.Wpad for p in prep0)
+        S2 = max(SPEC_BLOCK, next_pow2(max(p.m2.size for p in prep0)))
+        C = max(p.corr_idx.size for p in prep0)
+        periods = prep0[0].periods
+        assert all(p.periods == periods for p in prep0), "tier-1 periods diverged"
+        step = _make_step(mesh_key, Wpad, twin_kind, periods, ndev)
 
     def _pad1(a, n, fill=0):
         if a.size == n:
@@ -221,18 +305,6 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
         rt0 = time.perf_counter()
         preps = [prep0[s.seg_id] for s in batch]
         nbits_v = np.array([p.nbits for p in preps], np.int32)
-        patterns = tuple(
-            np.stack([p.patterns[i] for p in preps])
-            for i in range(len(periods))
-        )
-        m2 = np.stack([_pad1(p.m2, S2, 1 << 20) for p in preps])
-        r2 = np.stack([_pad1(p.r2, S2) for p in preps])
-        K2 = np.stack([_pad1(p.K2, S2, 1) for p in preps])
-        rcp2 = np.stack([_pad1(p.rcp2, S2, np.float32(2.0 ** -20)) for p in preps])
-        act2 = np.stack([_pad1(p.act2, S2) for p in preps])
-        ci = np.stack([_pad1(p.corr_idx, C) for p in preps])
-        cm = np.stack([_pad1(p.corr_mask, C) for p in preps])
-        pmask = np.array([p.pair_mask for p in preps], np.uint32)
         # gap_ok[d] = 1 iff (last candidate of seg d, first of seg d+1) is a
         # potential twin pair (values differ by 2) — odds on-device straddle
         gap_ok = np.zeros(ndev, np.int32)
@@ -242,9 +314,44 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                 fv = layout.first_candidate(batch[i + 1].lo)
                 if fv - lv == 2 and fv <= cfg.n:
                     gap_ok[i] = 1
-        total, total_twins, counts, twins_v, fw, lw = step(
-            nbits_v, patterns, m2, r2, K2, rcp2, act2, ci, cm, pmask, gap_ok
-        )
+        if use_pallas:
+            groups = [
+                np.stack([p.A[i] for p in preps]) for i in range(6)
+            ] + [
+                np.stack([p.B[i] for p in preps]) for i in range(6)
+            ] + [
+                np.stack([p.C[i] for p in preps]) for i in range(4)
+            ] + [
+                np.stack([p.D[i] for p in preps]) for i in range(4)
+            ]
+            total, total_twins, counts, twins_v, fw, lw = step(
+                nbits_v.reshape(-1, 1, 1),
+                np.array(
+                    [p.pair_mask for p in preps], np.uint32
+                ).reshape(-1, 1, 1),
+                *groups,
+                np.stack([p.corr_idx for p in preps]),
+                np.stack([p.corr_mask for p in preps]),
+                gap_ok,
+            )
+        else:
+            patterns = tuple(
+                np.stack([p.patterns[i] for p in preps])
+                for i in range(len(periods))
+            )
+            m2 = np.stack([_pad1(p.m2, S2, 1 << 20) for p in preps])
+            r2 = np.stack([_pad1(p.r2, S2) for p in preps])
+            K2 = np.stack([_pad1(p.K2, S2, 1) for p in preps])
+            rcp2 = np.stack(
+                [_pad1(p.rcp2, S2, np.float32(2.0 ** -20)) for p in preps]
+            )
+            act2 = np.stack([_pad1(p.act2, S2) for p in preps])
+            ci = np.stack([_pad1(p.corr_idx, C) for p in preps])
+            cm = np.stack([_pad1(p.corr_mask, C) for p in preps])
+            pmask = np.array([p.pair_mask for p in preps], np.uint32)
+            total, total_twins, counts, twins_v, fw, lw = step(
+                nbits_v, patterns, m2, r2, K2, rcp2, act2, ci, cm, pmask, gap_ok
+            )
         counts, twins_v = np.asarray(counts), np.asarray(twins_v)
         fw, lw = np.asarray(fw), np.asarray(lw)
         elapsed_round = time.perf_counter() - rt0

@@ -24,10 +24,11 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+@pytest.mark.parametrize("backend", ["jax", "tpu-pallas"])
 @pytest.mark.parametrize("packing", ["plain", "odds", "wheel30"])
-def test_mesh_1e5_8way(packing):
+def test_mesh_1e5_8way(packing, backend):
     cfg = SieveConfig(
-        n=10**5, backend="jax", packing=packing, workers=8, twins=True, quiet=True
+        n=10**5, backend=backend, packing=packing, workers=8, twins=True, quiet=True
     )
     res = run_mesh(cfg)
     assert res.pi == PI[10**5]
@@ -43,10 +44,11 @@ def test_mesh_device_counts(ndev):
     assert res.twin_pairs == TWINS[10**5]
 
 
-def test_mesh_rounds_streaming():
+@pytest.mark.parametrize("backend", ["jax", "tpu-pallas"])
+def test_mesh_rounds_streaming(backend):
     # rounds > 1: sequential dispatches, one segment per device per round
     cfg = SieveConfig(
-        n=10**6, workers=4, rounds=4, backend="jax", twins=True, quiet=True
+        n=10**6, workers=4, rounds=4, backend=backend, twins=True, quiet=True
     )
     res = run_mesh(cfg)
     assert res.pi == PI[10**6]
