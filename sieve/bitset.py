@@ -80,6 +80,11 @@ class Layout:
         v = np.arange(lo, hi, dtype=np.int64)
         return v[[self.is_candidate(int(x)) for x in v]]
 
+    def values_np(self, lo: int, bit_idx: np.ndarray) -> np.ndarray:
+        """Candidate values at segment-local bit indices (vectorized inverse
+        of bit_of; used by prime enumeration)."""
+        raise NotImplementedError
+
     # --- marking -----------------------------------------------------------------
     def mark_numpy(self, flags: np.ndarray, lo: int, hi: int, p: int) -> None:
         """Clear composite bits for prime p (p not in wheel_primes).
@@ -138,6 +143,9 @@ class PlainLayout(Layout):
             return
         flags[start - first :: p] = False
 
+    def values_np(self, lo: int, bit_idx: np.ndarray) -> np.ndarray:
+        return self.first_candidate(lo) + bit_idx.astype(np.int64)
+
     def twins_internal(self, flags: np.ndarray, lo: int, hi: int) -> int:
         if flags.size < 3:
             # fall back to direct check on tiny segments
@@ -182,6 +190,9 @@ class OddsLayout(Layout):
         b0 = (start - first) // 2
         flags[b0::p] = False  # stride p in value space == stride p in bit space
 
+    def values_np(self, lo: int, bit_idx: np.ndarray) -> np.ndarray:
+        return self.first_candidate(lo) + 2 * bit_idx.astype(np.int64)
+
     def twins_internal(self, flags: np.ndarray, lo: int, hi: int) -> int:
         if flags.size < 2:
             return 0
@@ -225,6 +236,11 @@ class Wheel30Layout(Layout):
                 continue
             b0 = self.gidx(v0) - g0
             flags[b0 :: 8 * p] = False  # v += 30p  =>  gidx += 8p
+
+    def values_np(self, lo: int, bit_idx: np.ndarray) -> np.ndarray:
+        res = np.array(WHEEL30_RESIDUES, dtype=np.int64)
+        g = self.gidx(self.first_candidate(lo)) + bit_idx.astype(np.int64)
+        return 30 * (g // 8) + res[g % 8]
 
     def twins_internal(self, flags: np.ndarray, lo: int, hi: int) -> int:
         # Candidate pairs differing by 2 are exactly gidx-adjacent with the

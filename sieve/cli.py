@@ -34,7 +34,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="sieve",
         description="TPU-native distributed segmented Sieve of Eratosthenes",
     )
-    p.add_argument("--n", type=_parse_n, required=True, help="sieve [2, N] inclusive (1e9 ok)")
+    p.add_argument("--n", type=_parse_n, default=None, help="sieve [2, N] inclusive (1e9 ok)")
+    p.add_argument("--emit-primes", default=None, metavar="LO:HI",
+                   help="print the primes in [LO, HI] inclusive (one per "
+                        "line; --json for a JSON array) instead of counting")
     p.add_argument("--backend", choices=BACKENDS, default="cpu-numpy")
     p.add_argument("--segments", type=int, default=None, dest="n_segments")
     p.add_argument("--segment-size", type=int, default=None, dest="segment_values",
@@ -42,6 +45,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--packing", choices=PACKINGS, default="odds")
     p.add_argument("--twins", action="store_true", help="also count twin-prime pairs")
     p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--multihost", action="store_true",
+                   help="multi-host SPMD: jax.distributed.initialize() "
+                        "first (coordinator/process env-configured, or "
+                        "--jax-coordinator/--jax-processes/--jax-process-id); "
+                        "--workers is then the GLOBAL device count")
+    p.add_argument("--jax-coordinator", default=None,
+                   help="coordinator address for --multihost (host:port)")
+    p.add_argument("--jax-processes", type=int, default=None)
+    p.add_argument("--jax-process-id", type=int, default=None)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--rounds", type=int, default=1,
@@ -60,6 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
 def config_from_args(args: argparse.Namespace) -> SieveConfig:
     return SieveConfig(
         n=args.n,
+        multihost=args.multihost,
         backend=args.backend,
         packing=args.packing,
         n_segments=args.n_segments,
@@ -80,14 +93,68 @@ def config_from_args(args: argparse.Namespace) -> SieveConfig:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     try:
+        if args.emit_primes is not None:
+            return _emit_primes(args)
+        if args.n is None:
+            print("sieve: error: --n is required (or use --emit-primes)",
+                  file=sys.stderr)
+            return 2
         return _run(args)
     except (ValueError, RuntimeError, ImportError) as e:
         print(f"sieve: error: {e}", file=sys.stderr)
         return 2
 
 
+def _emit_primes(args: argparse.Namespace) -> int:
+    from sieve.enumerate import primes_in_range
+
+    try:
+        lo_s, hi_s = args.emit_primes.split(":")
+        lo, hi = _parse_n(lo_s), _parse_n(hi_s)
+    except (ValueError, argparse.ArgumentTypeError):
+        raise ValueError(f"--emit-primes expects LO:HI, got {args.emit_primes!r}")
+    chunks = primes_in_range(args.packing, lo, hi + 1)
+    if args.json_output:
+        # stream the array chunk-by-chunk: the max span's output is GBs
+        sys.stdout.write("[")
+        first = True
+        for c in chunks:
+            if c.size:
+                if not first:
+                    sys.stdout.write(", ")
+                sys.stdout.write(", ".join(map(str, c.tolist())))
+                first = False
+        sys.stdout.write("]\n")
+    else:
+        for c in chunks:
+            sys.stdout.write("\n".join(map(str, c.tolist())))
+            if c.size:
+                sys.stdout.write("\n")
+    return 0
+
+
 def _run(args: argparse.Namespace) -> int:
     config = config_from_args(args)
+
+    if config.multihost:
+        # DCN path (SURVEY.md section 5.8): same program, collectives routed
+        # across hosts by JAX. Must happen before any device query.
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.jax_coordinator,
+            num_processes=args.jax_processes,
+            process_id=args.jax_process_id,
+        )
+        ndev = jax.device_count()
+        if config.backend not in ("jax", "tpu-pallas"):
+            raise ValueError("--multihost requires --backend jax/tpu-pallas")
+        if config.workers != ndev:
+            raise ValueError(
+                f"--multihost: --workers must equal the global device count "
+                f"({ndev}); got {config.workers}. Every process runs the "
+                "same SPMD program over the full mesh."
+            )
 
     import contextlib
 
@@ -125,6 +192,12 @@ def _dispatch(args: argparse.Namespace, config: SieveConfig) -> int:
         from sieve.coordinator import run_local
 
         result = run_local(config)
+
+    if config.multihost:
+        import jax
+
+        if jax.process_index() != 0:
+            return 0  # every process computes the same result; one prints
 
     if config.json_output:
         out = result.to_dict()

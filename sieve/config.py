@@ -40,6 +40,10 @@ class SieveConfig:
     resume: bool = False
     # Rounds: TPU dispatch granularity for failure recovery (section 5.3).
     rounds: int = 1
+    # Multi-host SPMD over DCN (SURVEY.md section 5.8): when True the CLI
+    # calls jax.distributed.initialize() before touching devices; workers
+    # must equal the GLOBAL device count.
+    multihost: bool = False
     # Observability.
     profile_dir: str | None = None
     quiet: bool = False

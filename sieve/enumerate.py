@@ -1,0 +1,61 @@
+"""Prime enumeration: materialize the primes in a subrange.
+
+The reference counts AND enumerates primes over [2, N] (SURVEY.md section 0
+[D]); counting is the scalable product, enumeration is the inspection tool.
+Emission is host/IO-bound by nature, so it runs the readable numpy marking
+(sieve/backends/cpu_numpy.py) over the requested window in segment-sized
+slices — any packing, any window inside [2, n+1), modest memory.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator
+
+import numpy as np
+
+from sieve.backends.cpu_numpy import sieve_segment_flags
+from sieve.bitset import get_layout
+from sieve.seed import seed_primes
+
+# Enumerating more than this per call is almost certainly a mistake (the
+# output alone would be GBs); counting is the scalable interface.
+MAX_SPAN = 10**9
+# Window position cap: the seed sieve needs isqrt(hi) memory (a 10**14
+# ceiling keeps it at ~10 MB). Windows beyond that need a segmented seed
+# sieve — out of scope for an inspection tool.
+MAX_HI = 10**14
+_SLICE = 1 << 24  # values per internal slice
+
+
+def primes_in_range(packing: str, lo: int, hi: int) -> Iterator[np.ndarray]:
+    """Yield ascending int64 arrays of the primes in [lo, hi).
+
+    Streams one array per internal slice so callers can print without
+    holding the whole result.
+    """
+    lo = max(lo, 2)
+    if hi <= lo:
+        return
+    if hi - lo > MAX_SPAN:
+        raise ValueError(
+            f"enumeration span {hi - lo} exceeds {MAX_SPAN}; "
+            "narrow the window (counting scales, enumeration is for windows)"
+        )
+    if hi > MAX_HI:
+        raise ValueError(
+            f"enumeration window ends at {hi} > {MAX_HI}: the seed sieve "
+            "for that offset would need isqrt(hi) memory"
+        )
+    layout = get_layout(packing)
+    seeds = seed_primes(math.isqrt(hi - 1))
+    for slo in range(lo, hi, _SLICE):
+        shi = min(slo + _SLICE, hi)
+        flags = sieve_segment_flags(packing, slo, shi, seeds)
+        vals = layout.values_np(slo, np.nonzero(flags)[0])
+        extras = np.array(
+            [p for p in layout.extra_primes if slo <= p < shi], dtype=np.int64
+        )
+        if extras.size:
+            vals = np.concatenate([extras, vals])
+        yield vals

@@ -95,11 +95,13 @@ def _register_mesh(mesh) -> tuple:
 
 
 def _collective_merge(count, twins, first32, last32, gap_ok, ndev: int):
-    """ICI collectives shared by both mesh steps (the TPU 'transport'
+    """ICI/DCN collectives shared by both mesh steps (the TPU 'transport'
     layer): psum count merge; left-neighbor ppermute of the first flag bit
     for the on-device odds straddle count (the host merge recomputes this
     exactly for every packing; the psum'd value cross-checks the
-    collective path)."""
+    collective path). Per-segment vectors come back all_gathered, i.e.
+    REPLICATED on every device — so on multi-host meshes every process can
+    read every segment's result without host-side exchange."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -111,14 +113,37 @@ def _collective_merge(count, twins, first32, last32, gap_ok, ndev: int):
     last_bit = (last32 >> jnp.uint32(31)).astype(jnp.int32)
     straddle = last_bit * recv * gap_ok[0]
     total_twins = lax.psum(twins + straddle, "seg")
+    gather = lambda x: lax.all_gather(x, "seg")
     return (
         total,
         total_twins,
-        count[None],
-        twins[None],
-        first32[None],
-        last32[None],
+        gather(count),
+        gather(twins),
+        gather(first32),
+        gather(last32),
     )
+
+
+def _globalize(mesh, tree):
+    """Host numpy inputs -> global jax.Arrays sharded over 'seg'.
+
+    On a multi-host mesh (DCN: ``jax.distributed.initialize``), jit cannot
+    transfer plain host arrays — every process holds the same full-size
+    numpy args (host prep is cheap and deterministic), and each contributes
+    only its addressable shards here. Single-host runs skip this."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    def conv(a):
+        a = np.asarray(a)
+        spec = P(*(("seg",) + (None,) * (a.ndim - 1)))
+        sh = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            a.shape, sh, lambda idx, _a=a: _a[idx]
+        )
+
+    return jax.tree.map(conv, tree)
 
 
 @functools.lru_cache(maxsize=None)
@@ -150,7 +175,7 @@ def _make_step(mesh_key, Wpad: int, twin_kind: int, periods: tuple, ndev: int):
         P("seg"), P("seg"),          # corrections
         P("seg"), P("seg"),          # pair_mask, gap_ok
     )
-    out_specs = (P(), P(), P("seg"), P("seg"), P("seg"), P("seg"))
+    out_specs = (P(),) * 6  # everything replicated (see _collective_merge)
     return _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs)
 
 
@@ -198,8 +223,28 @@ def _make_pallas_step(mesh_key, Wpad: int, twin_kind: int, SB: int, SC: int,
         return _collective_merge(count, twins, first32, last32, gap_ok, ndev)
 
     in_specs = (P("seg"),) * 25
-    out_specs = (P(), P(), P("seg"), P("seg"), P("seg"), P("seg"))
+    out_specs = (P(),) * 6  # everything replicated (see _collective_merge)
     return _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs)
+
+
+def _broadcast_done(done: dict) -> dict:
+    """Replicate process 0's completed-segment map to every process
+    (multi-host resume safety — see call site)."""
+    import json as _json
+
+    import numpy as np_
+    from jax.experimental import multihost_utils as mhu
+
+    blob = _json.dumps(
+        {str(k): v.to_dict() for k, v in done.items()}
+    ).encode()
+    n = int(mhu.broadcast_one_to_all(np_.int64(len(blob))))
+    buf = np_.zeros(n, np_.uint8)
+    k = min(len(blob), n)  # non-source content is ignored, only shape matters
+    buf[:k] = np_.frombuffer(blob, np_.uint8)[:k]
+    buf = np_.asarray(mhu.broadcast_one_to_all(buf))
+    data = _json.loads(bytes(buf).decode())
+    return {int(k): SegmentResult.from_dict(v) for k, v in data.items()}
 
 
 def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
@@ -292,11 +337,26 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
             return a
         return np.concatenate([a, np.full(n - a.size, fill, a.dtype)])
 
+    import jax
+
+    multihost = jax.process_count() > 1
+    if multihost:
+        raw_step = step
+        step = lambda *args: raw_step(*_globalize(mesh, args))
+
     ledger = Ledger.open(cfg) if cfg.checkpoint_dir else None
+    # multi-host: every process computes identical results; only process 0
+    # writes the ledger to avoid write races
+    record_ledger = ledger is not None and jax.process_index() == 0
     done: dict[int, SegmentResult] = {}
     if ledger is not None and cfg.resume:
         done = ledger.completed()
         metrics.event("resume", restored=len(done))
+    if multihost and ledger is not None:
+        # Every process must agree on which rounds to skip, or a process
+        # whose local ledger differs (non-shared checkpoint dir) would sit
+        # out a collective and deadlock the rest. Process 0's view wins.
+        done = _broadcast_done(done)
 
     for rnd in range(max(1, cfg.rounds)):
         batch = segs[rnd * ndev : (rnd + 1) * ndev]
@@ -372,7 +432,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                 elapsed_s=elapsed_round / ndev,
             )
             done[s.seg_id] = res
-            if ledger is not None:
+            if record_ledger:
                 ledger.record(res)
             metrics.segment(res)
         # cross-check: the ICI-collective totals agree with the host-side

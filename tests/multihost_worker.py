@@ -1,0 +1,45 @@
+"""Subprocess body for the multi-host mesh test (tests/test_multihost.py).
+
+Each process owns 4 virtual CPU devices; jax.distributed.initialize joins
+them into one 8-device mesh and run_mesh executes the identical SPMD
+program on both — the DCN scaling story of SURVEY.md section 5.8, minus
+the actual second host.
+
+Usage: multihost_worker.py <coordinator_addr> <num_processes> <process_id>
+"""
+
+import sys
+
+
+def main() -> int:
+    addr, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=nproc, process_id=pid
+    )
+    assert jax.process_count() == nproc
+
+    from sieve.config import SieveConfig
+    from sieve.parallel.mesh import run_mesh
+
+    cfg = SieveConfig(
+        n=10**5, backend="jax", workers=8, rounds=2, twins=True, quiet=True
+    )
+    res = run_mesh(cfg)
+    assert res.pi == 9_592, res.pi
+    assert res.twin_pairs == 1_224, res.twin_pairs
+
+    # pallas kernel (interpret mode) through the same multi-host mesh
+    cfg2 = SieveConfig(
+        n=10**5, backend="tpu-pallas", workers=8, twins=True, quiet=True
+    )
+    res2 = run_mesh(cfg2)
+    assert res2.pi == 9_592, res2.pi
+    assert res2.twin_pairs == 1_224, res2.twin_pairs
+    print(f"MULTIHOST_OK {pid} {res.pi} {res2.twin_pairs}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""Prime enumeration tests (SURVEY.md section 0: counting AND enumerating)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from sieve.cli import main
+from sieve.enumerate import primes_in_range
+from sieve.seed import seed_primes
+
+
+def _collect(packing, lo, hi):
+    chunks = list(primes_in_range(packing, lo, hi))
+    return np.concatenate(chunks) if chunks else np.zeros(0, np.int64)
+
+
+@pytest.mark.parametrize("packing", ["plain", "odds", "wheel30"])
+def test_enumerate_matches_seed_sieve(packing):
+    all_primes = seed_primes(10_000)
+    got = _collect(packing, 2, 10_001)
+    np.testing.assert_array_equal(got, all_primes)
+
+
+@pytest.mark.parametrize("packing", ["plain", "odds", "wheel30"])
+@pytest.mark.parametrize("lo,hi", [(2, 3), (2, 8), (90, 100), (7919, 7920),
+                                   (999_900, 1_000_100), (1, 2)])
+def test_enumerate_windows(packing, lo, hi):
+    all_primes = seed_primes(max(hi, 2))
+    want = all_primes[(all_primes >= lo) & (all_primes < hi)]
+    got = _collect(packing, lo, hi)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_enumerate_spans_internal_slices():
+    # window wider than one internal slice: chunk boundaries must not drop
+    # or duplicate primes
+    lo, hi = 10, 2**24 + 1000
+    got = _collect("odds", lo, hi)
+    assert got[0] == 11
+    assert np.all(np.diff(got) > 0)
+    want_count = seed_primes(hi - 1).size - 4  # minus 2, 3, 5, 7
+    assert got.size == want_count
+
+
+def test_enumerate_span_cap():
+    with pytest.raises(ValueError):
+        list(primes_in_range("odds", 2, 2 * 10**9 + 10))
+
+
+def test_cli_emit_primes(capsys):
+    assert main(["--emit-primes", "90:100"]) == 0
+    assert capsys.readouterr().out.split() == ["97"]
+    assert main(["--emit-primes", "2:30", "--json"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got == [2, 3, 5, 7, 11, 13, 17, 19, 23, 29]
+    assert main(["--emit-primes", "1:10", "--packing", "wheel30"]) == 0
+    assert capsys.readouterr().out.split() == ["2", "3", "5", "7"]
