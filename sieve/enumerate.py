@@ -32,12 +32,11 @@ def primes_in_range(packing: str, lo: int, hi: int) -> Iterator[np.ndarray]:
     """Yield ascending int64 arrays of the primes in [lo, hi).
 
     Streams one array per internal slice so callers can print without
-    holding the whole result.
+    holding the whole result. Bounds are validated eagerly (before the
+    first yield), so callers can start writing output once this returns.
     """
     lo = max(lo, 2)
-    if hi <= lo:
-        return
-    if hi - lo > MAX_SPAN:
+    if hi > lo + MAX_SPAN:
         raise ValueError(
             f"enumeration span {hi - lo} exceeds {MAX_SPAN}; "
             "narrow the window (counting scales, enumeration is for windows)"
@@ -47,6 +46,12 @@ def primes_in_range(packing: str, lo: int, hi: int) -> Iterator[np.ndarray]:
             f"enumeration window ends at {hi} > {MAX_HI}: the seed sieve "
             "for that offset would need isqrt(hi) memory"
         )
+    return _primes_in_range_gen(packing, lo, hi)
+
+
+def _primes_in_range_gen(packing: str, lo: int, hi: int) -> Iterator[np.ndarray]:
+    if hi <= lo:
+        return
     layout = get_layout(packing)
     seeds = seed_primes(math.isqrt(hi - 1))
     for slo in range(lo, hi, _SLICE):

@@ -115,8 +115,24 @@ def mark_words_impl(
         )
         words, _ = lax.scan(body, words, blocks)
 
+    return reduce_packed(words, nbits, twin_kind, pair_mask,
+                         corr_idx, corr_mask)
+
+
+def reduce_packed(words, nbits, twin_kind: int, pair_mask,
+                  corr_idx=None, corr_mask=None):
+    """Shared tail for both device kernels: self-mark corrections, validity
+    mask beyond nbits, popcount, twin reduction, boundary words.
+
+    ``words`` is the flat uint32 word array of one segment (padded); the
+    Pallas kernel emits raw marked words and runs this as an XLA postlude
+    (one extra HBM read per round — the in-kernel alternative was a
+    CC-unrolled correction loop whose live ranges blew VMEM at 1e12 scale).
+    """
+    w = lax.iota(jnp.int32, words.shape[0])
+
     # --- self-mark correction (seed primes inside the segment) -----------
-    if corr_idx.shape[0]:
+    if corr_idx is not None and corr_idx.shape[0]:
         cur = words[corr_idx]
         words = words.at[corr_idx].max(cur | corr_mask)
 

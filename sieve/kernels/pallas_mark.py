@@ -50,9 +50,10 @@ from sieve.kernels.specs import _pair_mask, tier1_specs
 
 import os as _os
 
-# Microbenchmarked on TPU v5e (n=1e9): R=64 -> 424ms, 128 -> 416ms,
-# 256 -> 406ms (best), 512 -> 554ms.
-R_ROWS = int(_os.environ.get("SIEVE_PALLAS_ROWS", "256"))  # tile = (R, 128) words
+# Microbenchmarked on TPU v5e. Pre-group-D (n=1e9): R=64 -> 424ms,
+# 128 -> 416ms, 256 -> 406ms, 512 -> 554ms. With group D (n=1e10 segment):
+# 64 -> 914ms, 128 -> 901ms (best), 256 -> 931ms, 512 -> 1007ms.
+R_ROWS = int(_os.environ.get("SIEVE_PALLAS_ROWS", "128"))  # tile = (R, 128) words
 TILE_WORDS = R_ROWS * 128
 NA_PAD = 16                     # group-A slots (>= 11 primes below 32)
 A_LAYERS = 16                   # max marked bits per word (m=2 -> 16)
@@ -242,17 +243,19 @@ def _onebit(t, act):
     return hit & act
 
 
-def _make_kernel(twin_kind: int, SB: int, SC: int, ND: int, CC: int):
-    shift = 2 if twin_kind == 1 else 1  # TWIN_PLAIN else adjacent
+def _make_kernel(SB: int, SC: int, ND: int):
+    """Pure marking kernel: specs in, marked words out. Corrections, the
+    validity mask, counting, twins, and boundary words all happen in the
+    XLA postlude (jax_mark.reduce_packed) — keeping them here cost an
+    unrolled CC-length correction loop and sequential-grid accumulators
+    whose live ranges blew VMEM once every seed prime sat in segment 0
+    (N = 1e12 puts all 78k of them there)."""
 
-    def kernel(nbits_ref, pmask_ref,
-               Am, ArK, AM1, Arcp1, Arcp, Aact,
+    def kernel(Am, ArK, AM1, Arcp1, Arcp, Aact,
                Bm, BrK, BM1, Brcp1, Brcp, Bact,
                Cm, CrK, Crcp, Cact,
                Dm, DrK, Drcp, Dact,
-               ci_ref, cm_ref,
-               words_ref, count_ref, twin_ref,
-               prev_ref):
+               words_ref):
         t = pl.program_id(0)
         base = t * TILE_WORDS
         row = lax.broadcasted_iota(jnp.int32, (R_ROWS, 128), 0)
@@ -322,70 +325,14 @@ def _make_kernel(twin_kind: int, SB: int, SC: int, ND: int, CC: int):
 
             words = lax.fori_loop(0, ND, dbody, words)
 
-        # --- self-mark corrections (vector compare, no scatter) ----------
-        wg = base + row * 128 + lane
-        corr = jnp.zeros((R_ROWS, 128), _U32)
-        for j in range(CC):
-            corr = corr | jnp.where(wg == ci_ref[0, j], cm_ref[0, j], _U32(0))
-        words = words | corr
-
-        # --- validity mask beyond nbits ----------------------------------
-        nbits = nbits_ref[0, 0]
-        bv = jnp.clip(nbits - w32, 0, 32)
-        full = bv >= 32
-        part = (_U32(1) << (jnp.minimum(bv, 31).astype(_U32))) - _U32(1)
-        words = words & jnp.where(full, _U32(0xFFFFFFFF), part)
-
         words_ref[:, :] = words
-
-        # --- count -------------------------------------------------------
-        cnt = jnp.sum(lax.population_count(words), dtype=jnp.int32)
-
-        @pl.when(t == 0)
-        def _():
-            count_ref[0, 0] = 0
-            twin_ref[0, 0] = 0
-
-        count_ref[0, 0] += cnt
-
-        # --- twins ---------------------------------------------------
-        if twin_kind:
-            pmask = pmask_ref[0, 0]
-            a = pltpu.roll(words, 127, axis=1)         # lane l+1 (wraps)
-            b = pltpu.roll(a, R_ROWS - 1, axis=0)      # row r+1 of lane 0
-            nxt = jnp.where(lane < 127, a, b)
-            # the tile's very last word has no in-tile successor (roll wraps
-            # to words[0,0]); its cross-word pairs are counted by the
-            # prev/cross mechanism of the NEXT grid step instead
-            is_last = (row == R_ROWS - 1) & (lane == 127)
-            nxt = jnp.where(is_last, _U32(0), nxt)
-            spliced = (words >> _U32(shift)) | (
-                nxt & _U32((1 << shift) - 1)
-            ) << _U32(32 - shift)
-            pairs = words & spliced & pmask
-            tw = jnp.sum(lax.population_count(pairs), dtype=jnp.int32)
-            # cross-tile boundary: last word of the previous tile
-            prev = prev_ref[0, 0]
-            first = words[0, 0]
-            lowbits = _U32((1 << shift) - 1)
-            crossw = (prev >> _U32(32 - shift)) & (first & lowbits) \
-                & (pmask >> _U32(32 - shift))
-            # crossw has at most `shift` (<= 2) bits; Mosaic has no scalar
-            # popcount, so count them arithmetically
-            cross = ((crossw & _U32(1)) + ((crossw >> _U32(1)) & _U32(1))).astype(
-                jnp.int32
-            )
-            tw = tw + jnp.where(t > 0, cross, 0)
-            twin_ref[0, 0] += tw
-            prev_ref[0, 0] = words[R_ROWS - 1, 127]
 
     return kernel
 
 
 @functools.lru_cache(maxsize=None)
-def _build_call(Wpad: int, twin_kind: int, SB: int, SC: int, ND: int, CC: int,
-                interpret: bool):
-    kernel = _make_kernel(twin_kind, SB, SC, ND, CC)
+def _build_call(Wpad: int, SB: int, SC: int, ND: int, interpret: bool):
+    kernel = _make_kernel(SB, SC, ND)
     Wrows = Wpad // 128
     grid = Wpad // TILE_WORDS
 
@@ -401,79 +348,62 @@ def _build_call(Wpad: int, twin_kind: int, SB: int, SC: int, ND: int, CC: int,
             (nrows, D_LANES), lambda t: (0, 0), memory_space=pltpu.VMEM
         )
 
-    smem_scalar = pl.BlockSpec((1, 1), lambda t: (0, 0), memory_space=pltpu.SMEM)
     in_specs = (
-        [smem_scalar, smem_scalar]
-        + [smem(NA_PAD)] * 6
+        [smem(NA_PAD)] * 6
         + [smem(SB)] * 6
         + [smem(SC)] * 4
         + [vmem_rows(max(ND, 1))] * 4
-        + [smem(CC)] * 2
     )
     call = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=in_specs,
-        out_specs=(
-            pl.BlockSpec((R_ROWS, 128), lambda t: (t, 0),
-                         memory_space=pltpu.VMEM),
-            smem_scalar,
-            smem_scalar,
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((Wrows, 128), jnp.uint32),
-            jax.ShapeDtypeStruct((1, 1), jnp.int32),
-            jax.ShapeDtypeStruct((1, 1), jnp.int32),
-        ),
-        scratch_shapes=[pltpu.SMEM((1, 1), jnp.uint32)],
+        out_specs=pl.BlockSpec((R_ROWS, 128), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Wrows, 128), jnp.uint32),
         # group D's unrolled 128-rotation placement keeps more scheduler
         # temporaries live than the default 16M scoped-VMEM budget allows
         compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024,
+            vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=interpret,
     )
     return call
 
 
-@functools.lru_cache(maxsize=None)
-def _build_call_jit(Wpad, twin_kind, SB, SC, ND, CC, interpret):
-    call = _build_call(Wpad, twin_kind, SB, SC, ND, CC, interpret)
-    return jax.jit(lambda *args: call(*args))
+def _postlude(words, nbits, pair_mask, ci, cm, twin_kind: int):
+    """XLA tail on the kernel's words: corrections + reductions."""
+    from sieve.kernels.jax_mark import reduce_packed
 
-
-@functools.partial(jax.jit, static_argnames=("Wpad",))
-def _boundary_on_device(Wpad, words_flat, nbits):
-    """first/last 32 flag bits as uint32 scalars — computed on device so the
-    host never pulls the (up to 128 MB) word array over the wire."""
-    first = words_flat[0]
-    off = nbits - 32
-    wl = off // 32
-    sh = (off % 32).astype(_U32)
-    pair = lax.dynamic_slice(words_flat, (wl,), (2,))
-    last = (pair[0] >> sh) | jnp.where(
-        sh == 0, _U32(0), pair[1] << (_U32(32) - sh)
+    return reduce_packed(
+        words.reshape(-1), nbits, twin_kind, pair_mask, ci, cm
     )
-    return first, last
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call_jit(Wpad, twin_kind, SB, SC, ND, interpret):
+    call = _build_call(Wpad, SB, SC, ND, interpret)
+
+    def run(nbits, pmask, A_B_C_D_args, ci, cm):
+        words = call(*A_B_C_D_args)
+        return _postlude(words, nbits, pmask, ci, cm, twin_kind)
+
+    return jax.jit(run, static_argnames=())
 
 
 def mark_pallas(ps: PallasSegment, twin_kind: int, interpret: bool):
-    """Run the fused kernel; returns (count, twins, first_word, last_word).
-
-    The packed words stay on device; only four scalars cross to the host.
-    """
+    """Run the marking kernel + XLA postlude; returns (count, twins,
+    first_word, last_word). The packed words stay on device; only four
+    scalars cross to the host."""
     SB = ps.B[0].shape[1]
     SC = ps.C[0].shape[1]
     ND = ps.D[0].shape[0] if ps.D[3].any() else 0
-    CC = ps.corr_idx.shape[1]
-    call = _build_call_jit(ps.Wpad, twin_kind, SB, SC, ND, CC, interpret)
-    words, count, twins = call(
-        np.array([[ps.nbits]], np.int32),
-        np.array([[ps.pair_mask]], np.uint32),
-        *ps.A, *ps.B, *ps.C, *ps.D,
-        ps.corr_idx, ps.corr_mask,
+    call = _build_call_jit(ps.Wpad, twin_kind, SB, SC, ND, interpret)
+    count, twins, first, last = call(
+        np.int32(ps.nbits),
+        np.uint32(ps.pair_mask),
+        tuple(ps.A) + tuple(ps.B) + tuple(ps.C) + tuple(ps.D),
+        ps.corr_idx[0],
+        ps.corr_mask[0],
     )
-    first, last = _boundary_on_device(
-        ps.Wpad, words.reshape(-1), jnp.int32(ps.nbits)
-    )
-    return int(count[0, 0]), int(twins[0, 0]), int(first), int(last)
+    return int(count), int(twins), int(first), int(last)

@@ -205,20 +205,18 @@ def _make_pallas_step(mesh_key, Wpad: int, twin_kind: int, SB: int, SC: int,
     mode, so the multi-chip path is CI-testable without TPU hardware."""
     from jax.sharding import PartitionSpec as P
 
-    from sieve.kernels.pallas_mark import _boundary_on_device, _build_call
+    from sieve.kernels.pallas_mark import _build_call, _postlude
 
     mesh = _MESHES[mesh_key]
     smap = _shard_map()
-    call = _build_call(Wpad, twin_kind, SB, SC, ND, CC, interpret)
+    call = _build_call(Wpad, SB, SC, ND, interpret)
 
     def shard_fn(nbits, pmask, *rest):
         groups = tuple(a[0] for a in rest[:20])   # A(6) + B(6) + C(4) + D(4)
-        ci, cm, gap_ok = rest[20][0], rest[21][0], rest[22]
-        words, count, twins = call(nbits[0], pmask[0], *groups, ci, cm)
-        count = count[0, 0]
-        twins = twins[0, 0]
-        first32, last32 = _boundary_on_device(
-            Wpad, words.reshape(-1), nbits[0, 0, 0]
+        ci, cm, gap_ok = rest[20][0, 0], rest[21][0, 0], rest[22]
+        words = call(*groups)
+        count, twins, first32, last32 = _postlude(
+            words, nbits[0, 0, 0], pmask[0, 0, 0], ci, cm, twin_kind
         )
         return _collective_merge(count, twins, first32, last32, gap_ok, ndev)
 
