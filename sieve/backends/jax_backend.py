@@ -81,7 +81,7 @@ class JaxWorker(SieveWorker):
         ts = prepare_segment(packing, lo, hi, seed_primes)
         twin_kind = TWIN_KIND[packing] if self.config.twins else TWIN_NONE
         with self._placement():
-            count, twins, first32, last32 = mark_words(
+            packed = np.asarray(mark_words(
                 ts.Wpad,
                 twin_kind,
                 ts.periods,
@@ -90,10 +90,11 @@ class JaxWorker(SieveWorker):
                 ts.m2, ts.r2, ts.K2, ts.rcp2, ts.act2,
                 ts.corr_idx, ts.corr_mask,
                 np.uint32(ts.pair_mask),
-            )
-        count = int(count) + layout.extras_in(lo, hi)
+            ))  # one uint32[4] fetch: count, twins, first32, last32
+        count, twins, first32, last32 = (int(v) for v in packed)
+        count += layout.extras_in(lo, hi)
         twin_count = (
-            int(twins) + layout.extra_twin_pairs(lo, hi)
+            twins + layout.extra_twin_pairs(lo, hi)
             if self.config.twins
             else 0
         )

@@ -385,8 +385,10 @@ def _build_call_jit(Wpad, twin_kind, SB, SC, ND, interpret):
     call = _build_call(Wpad, SB, SC, ND, interpret)
 
     def run(nbits, pmask, A_B_C_D_args, ci, cm):
+        from sieve.kernels.jax_mark import pack4
+
         words = call(*A_B_C_D_args)
-        return _postlude(words, nbits, pmask, ci, cm, twin_kind)
+        return pack4(*_postlude(words, nbits, pmask, ci, cm, twin_kind))
 
     return jax.jit(run, static_argnames=())
 
@@ -399,11 +401,11 @@ def mark_pallas(ps: PallasSegment, twin_kind: int, interpret: bool):
     SC = ps.C[0].shape[1]
     ND = ps.D[0].shape[0] if ps.D[3].any() else 0
     call = _build_call_jit(ps.Wpad, twin_kind, SB, SC, ND, interpret)
-    count, twins, first, last = call(
+    packed = np.asarray(call(
         np.int32(ps.nbits),
         np.uint32(ps.pair_mask),
         tuple(ps.A) + tuple(ps.B) + tuple(ps.C) + tuple(ps.D),
         ps.corr_idx[0],
         ps.corr_mask[0],
-    )
-    return int(count), int(twins), int(first), int(last)
+    ))  # one uint32[4] fetch: count, twins, first, last
+    return int(packed[0]), int(packed[1]), int(packed[2]), int(packed[3])

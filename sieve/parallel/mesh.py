@@ -114,14 +114,17 @@ def _collective_merge(count, twins, first32, last32, gap_ok, ndev: int):
     straddle = last_bit * recv * gap_ok[0]
     total_twins = lax.psum(twins + straddle, "seg")
     gather = lambda x: lax.all_gather(x, "seg")
-    return (
-        total,
-        total_twins,
-        gather(count),
-        gather(twins),
-        gather(first32),
-        gather(last32),
-    )
+    # ONE packed uint32[2 + 4*ndev] result: [total, total_twins, counts...,
+    # twins..., first32..., last32...]. A single replicated output means a
+    # single device->host fetch per round — over a tunneled device each
+    # separate fetch costs a full round trip (~70 ms measured on axon).
+    return jnp.concatenate([
+        jnp.stack([total, total_twins]).astype(jnp.uint32),
+        gather(count).astype(jnp.uint32).reshape(-1),
+        gather(twins).astype(jnp.uint32).reshape(-1),
+        gather(first32).reshape(-1),
+        gather(last32).reshape(-1),
+    ])
 
 
 def _globalize(mesh, tree):
@@ -175,7 +178,7 @@ def _make_step(mesh_key, Wpad: int, twin_kind: int, periods: tuple, ndev: int):
         P("seg"), P("seg"),          # corrections
         P("seg"), P("seg"),          # pair_mask, gap_ok
     )
-    out_specs = (P(),) * 6  # everything replicated (see _collective_merge)
+    out_specs = P()  # one packed replicated vector (see _collective_merge)
     return _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs)
 
 
@@ -221,7 +224,7 @@ def _make_pallas_step(mesh_key, Wpad: int, twin_kind: int, SB: int, SC: int,
         return _collective_merge(count, twins, first32, last32, gap_ok, ndev)
 
     in_specs = (P("seg"),) * 25
-    out_specs = (P(),) * 6  # everything replicated (see _collective_merge)
+    out_specs = P()  # one packed replicated vector (see _collective_merge)
     return _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs)
 
 
@@ -356,6 +359,62 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
         # out a collective and deadlock the rest. Process 0's view wins.
         done = _broadcast_done(done)
 
+    # Async round window: dispatch round k while round k-1 still runs on
+    # device, fetching each round's ONE packed result vector at most
+    # `window` rounds late. Overlaps host prep/stacking and device->host
+    # round trips (tunnel RTT ~70 ms) with device compute; checkpoint
+    # granularity worsens by at most `window` rounds on failure.
+    window = max(0, int(os.environ.get("SIEVE_ROUND_WINDOW", "2")))
+    pending: list = []
+
+    def _drain_one():
+        batch, nbits_b, out, rt0 = pending.pop(0)
+        vals = np.asarray(out).astype(np.int64)  # single uint32 fetch
+        total = int(vals[0])
+        total_twins = int(vals[1])
+        counts = vals[2 : 2 + ndev]
+        twins_v = vals[2 + ndev : 2 + 2 * ndev]
+        fw = vals[2 + 2 * ndev : 2 + 3 * ndev]
+        lw = vals[2 + 3 * ndev : 2 + 4 * ndev]
+        # dispatch-to-fetch time; with a nonzero window this includes
+        # overlapped rounds, so it bounds rather than equals device time
+        elapsed_round = time.perf_counter() - rt0
+        for i, s in enumerate(batch):
+            res = SegmentResult(
+                seg_id=s.seg_id,
+                lo=s.lo,
+                hi=s.hi,
+                count=int(counts[i]) + layout.extras_in(s.lo, s.hi),
+                twin_count=(
+                    int(twins_v[i]) + layout.extra_twin_pairs(s.lo, s.hi)
+                    if cfg.twins
+                    else 0
+                ),
+                first_word=int(fw[i]),
+                last_word=int(lw[i]),
+                nbits=int(nbits_b[i]),
+                elapsed_s=elapsed_round / ndev,
+            )
+            done[s.seg_id] = res
+            if record_ledger:
+                ledger.record(res)
+            metrics.segment(res)
+        # cross-check: the ICI-collective totals agree with the host-side
+        # merge semantics (psum for counts; psum + ppermute straddle for
+        # the odds twin path — the transport this path exists to exercise)
+        assert total == int(counts.sum()), "psum/count mismatch"
+        if cfg.twins and cfg.packing == "odds":
+            from sieve.twins import straddle_twins
+
+            batch_res = [done[s.seg_id] for s in batch]
+            expect = int(twins_v.sum()) + sum(
+                straddle_twins(layout, a, b, cfg.n)
+                for a, b in zip(batch_res, batch_res[1:])
+            )
+            assert total_twins == expect, (
+                f"ppermute twin path diverged: {total_twins} != {expect}"
+            )
+
     for rnd in range(max(1, cfg.rounds)):
         batch = segs[rnd * ndev : (rnd + 1) * ndev]
         if all(s.seg_id in done for s in batch):
@@ -382,7 +441,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
             ] + [
                 np.stack([p.D[i] for p in preps]) for i in range(4)
             ]
-            total, total_twins, counts, twins_v, fw, lw = step(
+            out = step(
                 nbits_v.reshape(-1, 1, 1),
                 np.array(
                     [p.pair_mask for p in preps], np.uint32
@@ -407,47 +466,15 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
             ci = np.stack([_pad1(p.corr_idx, C) for p in preps])
             cm = np.stack([_pad1(p.corr_mask, C) for p in preps])
             pmask = np.array([p.pair_mask for p in preps], np.uint32)
-            total, total_twins, counts, twins_v, fw, lw = step(
+            out = step(
                 nbits_v, patterns, m2, r2, K2, rcp2, act2, ci, cm, pmask, gap_ok
             )
-        counts, twins_v = np.asarray(counts), np.asarray(twins_v)
-        fw, lw = np.asarray(fw), np.asarray(lw)
-        elapsed_round = time.perf_counter() - rt0
-        for i, s in enumerate(batch):
-            res = SegmentResult(
-                seg_id=s.seg_id,
-                lo=s.lo,
-                hi=s.hi,
-                count=int(counts[i]) + layout.extras_in(s.lo, s.hi),
-                twin_count=(
-                    int(twins_v[i]) + layout.extra_twin_pairs(s.lo, s.hi)
-                    if cfg.twins
-                    else 0
-                ),
-                first_word=int(fw[i]),
-                last_word=int(lw[i]),
-                nbits=int(nbits_v[i]),
-                elapsed_s=elapsed_round / ndev,
-            )
-            done[s.seg_id] = res
-            if record_ledger:
-                ledger.record(res)
-            metrics.segment(res)
-        # cross-check: the ICI-collective totals agree with the host-side
-        # merge semantics (psum for counts; psum + ppermute straddle for the
-        # odds twin path — the one transport this path exists to exercise)
-        assert int(total) == int(counts.sum()), "psum/count mismatch"
-        if cfg.twins and cfg.packing == "odds":
-            from sieve.twins import straddle_twins
+        pending.append((batch, nbits_v, out, rt0))
+        while len(pending) > window:
+            _drain_one()
 
-            batch_res = [done[s.seg_id] for s in batch]
-            expect = int(twins_v.sum()) + sum(
-                straddle_twins(layout, a, b, cfg.n)
-                for a, b in zip(batch_res, batch_res[1:])
-            )
-            assert int(total_twins) == expect, (
-                f"ppermute twin path diverged: {int(total_twins)} != {expect}"
-            )
+    while pending:
+        _drain_one()
 
     results = [done[s.seg_id] for s in segs]
     pi, twin_pairs = merge_results(cfg, results)

@@ -62,7 +62,7 @@ def main() -> int:
                 ts.patterns, ts.m2, ts.r2, ts.K2, ts.rcp2, ts.act2,
                 ts.corr_idx, ts.corr_mask, np.uint32(ts.pair_mask),
             )
-            return [np.asarray(o) for o in jax.tree.leaves(out)]
+            return list(np.asarray(out))  # packed uint32[4]
 
     t0 = time.perf_counter()
     out = call()

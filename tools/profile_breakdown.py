@@ -1,0 +1,88 @@
+"""Decompose the 1e9 single-segment pallas run: host prep vs device kernel
+vs postlude vs coordinator overhead. Run on the real chip."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def t(fn, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main():
+    import jax
+
+    jax.devices()  # initialize the platform plugin before any jit
+
+    from sieve.kernels.pallas_mark import (
+        _build_call, _build_call_jit, mark_pallas, prepare_pallas,
+    )
+    from sieve.seed import seed_primes
+
+    n = int(float(sys.argv[1])) if len(sys.argv) > 1 else 10**9
+    lo, hi = 2, n + 1
+    import math
+
+    seeds = seed_primes(math.isqrt(n))
+    print(f"n={n:.0e} seeds={seeds.size}")
+
+    dt, ps = t(lambda: prepare_pallas("odds", lo, hi, seeds))
+    print(f"prepare_pallas (host):      {dt*1e3:9.1f} ms")
+    SB = ps.B[0].shape[1]
+    SC = ps.C[0].shape[1]
+    ND = ps.D[0].shape[0] if ps.D[3].any() else 0
+    print(f"  Wpad={ps.Wpad} SB={SB} SC={SC} ND={ND} "
+          f"CC={ps.corr_idx.shape[1]}")
+
+    # kernel only (no postlude), warm
+    call = _build_call(ps.Wpad, SB, SC, ND, interpret=False)
+    args = tuple(ps.A) + tuple(ps.B) + tuple(ps.C) + tuple(ps.D)
+    jcall = jax.jit(lambda *a: call(*a))
+    jcall(*args).block_until_ready()
+    dt, _ = t(lambda: jcall(*args).block_until_ready())
+    print(f"pallas kernel only (device):{dt*1e3:9.1f} ms")
+
+    # kernel + postlude (the full mark_pallas jit), warm
+    full = _build_call_jit(ps.Wpad, 1, SB, SC, ND, False)
+    fargs = (np.int32(ps.nbits), np.uint32(ps.pair_mask), args,
+             ps.corr_idx[0], ps.corr_mask[0])
+    jax.block_until_ready(full(*fargs))
+    dt, _ = t(lambda: jax.block_until_ready(full(*fargs)))
+    print(f"kernel + postlude (device): {dt*1e3:9.1f} ms")
+
+    # whole mark_pallas incl. host->device transfers of specs
+    dt, _ = t(lambda: mark_pallas(ps, 1, False))
+    print(f"mark_pallas end-to-end:     {dt*1e3:9.1f} ms")
+
+    # full run_local
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+
+    cfg = SieveConfig(n=n, backend="tpu-pallas", packing="odds",
+                      n_segments=1, twins=False, quiet=True)
+    run_local(cfg)
+    dt, res = t(lambda: run_local(cfg))
+    print(f"run_local end-to-end:       {dt*1e3:9.1f} ms   pi={res.pi}")
+
+
+if __name__ == "__main__":
+    main()

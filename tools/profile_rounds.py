@@ -1,0 +1,43 @@
+"""Time the mesh round path (workers=1) on the real chip: warm, then
+measure. Usage: python tools/profile_rounds.py [n] [rounds] [--twins]"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(float(args[0])) if args else 10**10
+    rounds = int(args[1]) if len(args) > 1 else 8
+    twins = "--twins" in sys.argv
+
+    from sieve.config import SieveConfig
+    from sieve.parallel.mesh import run_mesh
+
+    cfg = SieveConfig(n=n, backend="tpu-pallas", packing="odds", workers=1,
+                      rounds=rounds, twins=twins, quiet=True)
+    t0 = time.perf_counter()
+    res = run_mesh(cfg)
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res = run_mesh(cfg)
+    warm = time.perf_counter() - t0
+    print(f"n={n:.0e} rounds={rounds} twins={twins} pi={res.pi} "
+          f"twin={res.twin_pairs}")
+    print(f"cold={cold:.2f}s warm={warm:.2f}s "
+          f"({(n - 1) / warm:.3e} values/s warm)")
+
+
+if __name__ == "__main__":
+    main()

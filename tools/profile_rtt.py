@@ -1,0 +1,108 @@
+"""Separate axon-tunnel round-trip latency from true device compute:
+time k back-to-back kernel dispatches with ONE final scalar readback.
+Slope over k = real per-dispatch device time; intercept = RTT + fixed."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import math
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from sieve.kernels.pallas_mark import _build_call, _postlude, prepare_pallas
+    from sieve.seed import seed_primes
+
+    n = int(float(sys.argv[1])) if len(sys.argv) > 1 else 10**9
+    lo, hi = 2, n + 1
+    seeds = seed_primes(math.isqrt(n))
+
+    # RTT floor: trivial scalar jit round trip
+    f = jax.jit(lambda x: x + 1)
+    int(f(np.int32(1)))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        v = int(f(np.int32(1)))
+    rtt = (time.perf_counter() - t0) / 5
+    print(f"scalar jit round-trip:  {rtt*1e3:8.1f} ms")
+
+    ps = prepare_pallas("odds", lo, hi, seeds)
+    SB, SC = ps.B[0].shape[1], ps.C[0].shape[1]
+    ND = ps.D[0].shape[0] if ps.D[3].any() else 0
+    call = _build_call(ps.Wpad, SB, SC, ND, interpret=False)
+    args = tuple(ps.A) + tuple(ps.B) + tuple(ps.C) + tuple(ps.D)
+
+    def chain(k):
+        @jax.jit
+        def run(*a):
+            acc = jnp.uint32(0)
+            for _ in range(k):
+                w = call(*a)
+                c, tw, fw, lw = _postlude(
+                    w, np.int32(ps.nbits), np.uint32(ps.pair_mask),
+                    ps.corr_idx[0], ps.corr_mask[0], 1)
+                acc = acc + c.astype(jnp.uint32)
+            return acc
+
+        return run
+
+    for k in (1, 2, 4, 8):
+        r = chain(k)
+        int(r(*args))  # compile + warm
+        t0 = time.perf_counter()
+        v = int(r(*args))
+        dt = time.perf_counter() - t0
+        print(f"k={k}: total {dt*1e3:8.1f} ms   ({dt/k*1e3:8.1f} ms/dispatch)")
+
+
+def main2():
+    """Device-resident args variant: isolates transfer cost (--args)."""
+    import jax
+    import math
+    import jax.numpy as jnp
+
+    from sieve.kernels.pallas_mark import _build_call_jit, prepare_pallas
+    from sieve.seed import seed_primes
+
+    n = int(float(sys.argv[1])) if len(sys.argv) > 1 else 10**9
+    seeds = seed_primes(math.isqrt(n))
+    ps = prepare_pallas("odds", 2, n + 1, seeds)
+    SB, SC = ps.B[0].shape[1], ps.C[0].shape[1]
+    ND = ps.D[0].shape[0] if ps.D[3].any() else 0
+    full = _build_call_jit(ps.Wpad, 1, SB, SC, ND, False)
+    host_args = (np.int32(ps.nbits), np.uint32(ps.pair_mask),
+                 tuple(ps.A) + tuple(ps.B) + tuple(ps.C) + tuple(ps.D),
+                 ps.corr_idx[0], ps.corr_mask[0])
+    dev_args = jax.device_put(host_args)
+    jax.block_until_ready(dev_args)
+    for label, args in (("host args", host_args), ("device args", dev_args)):
+        np.asarray(full(*args))  # warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(full(*args))
+            best = min(best, time.perf_counter() - t0)
+        print(f"{label}: {best*1e3:8.1f} ms end-to-end")
+    t0 = time.perf_counter()
+    dev_args2 = jax.device_put(host_args)
+    jax.block_until_ready(dev_args2)
+    print(f"device_put of args: {(time.perf_counter()-t0)*1e3:8.1f} ms")
+
+
+if __name__ == "__main__":
+    main2() if "--args" in sys.argv else main()
