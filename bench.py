@@ -1,15 +1,27 @@
-"""Benchmark: pi(1e9), odds packing, jax backend on the real chip.
+"""Benchmark: the shallow AND depth regimes of the pallas sieve.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline: BASELINE.md's measured CPU floor — pi(1e9) segmented numpy in
-7.5 s single process == 1.33e8 values/s. vs_baseline is the speedup of
-this run's values/s over that floor. Exact pi parity is asserted before
-any number is printed: a fast wrong sieve scores zero.
+Prints TWO JSON lines {"metric", "value", "unit", "vs_baseline"}:
+
+1. pi(1e9), odds packing, tpu-pallas backend — the shallow regime.
+   Baseline: BASELINE.md's measured CPU floor — pi(1e9) segmented numpy
+   in 7.5 s single process == 1.33e8 values/s.
+2. Warm values/s on ONE 10^9-span odds segment at lo = 10^12 - 10^9 with
+   the full 78,498-seed set (ND=609 group-D blocks) — the regime the
+   north star (pi(10^12) < 60 s) actually lives in, where the rate used
+   to collapse 11.5x below the shallow number. Baseline: the 4.06e8
+   values/s/chip probe measured on v5e (VERDICT.md round 5). Emitted on
+   TPU only (interpret mode would take hours); force with
+   SIEVE_BENCH_DEPTH=1.
+
+Exact parity is asserted before any number is printed — the depth line
+against a cpu-numpy run of the same segment: a fast wrong sieve scores
+zero.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -25,8 +37,14 @@ N = 10**9
 PI_N = 50_847_534  # BASELINE.md oracle (computed, 2026-07-29)
 BASELINE_VALUES_PER_SEC = (N - 1) / 7.5  # BASELINE.md CPU floor
 
+DEPTH_SPAN = 10**9
+DEPTH_LO = 10**12 - DEPTH_SPAN
+DEPTH_HI = 10**12 + 1  # seed set = seed_primes(10^6) = 78,498 primes
+# VERDICT.md round-5 probe: 2.45 s warm per 10^9-value segment on one v5e
+DEPTH_BASELINE_VALUES_PER_SEC = 4.06e8
 
-def main() -> int:
+
+def shallow_metric() -> None:
     from sieve.config import SieveConfig
     from sieve.coordinator import run_local
 
@@ -55,6 +73,66 @@ def main() -> int:
             }
         )
     )
+
+
+def depth_metric() -> None:
+    import jax
+
+    if jax.devices()[0].platform != "tpu" and not os.environ.get(
+        "SIEVE_BENCH_DEPTH"
+    ):
+        print(
+            "depth metric skipped: no TPU (interpret mode would take hours; "
+            "force with SIEVE_BENCH_DEPTH=1)",
+            file=sys.stderr,
+        )
+        return
+
+    from sieve.backends.cpu_numpy import CpuNumpyWorker
+    from sieve.backends.tpu_pallas import PallasWorker
+    from sieve.config import SieveConfig
+    from sieve.seed import seed_primes
+
+    lo, hi = DEPTH_LO, DEPTH_HI
+    cfg = SieveConfig(
+        n=10**12, backend="tpu-pallas", packing="odds", twins=True, quiet=True
+    )
+    seeds = seed_primes(math.isqrt(hi - 1))
+    worker = PallasWorker(cfg)
+    cold = worker.process_segment(lo, hi, seeds)  # compile + warm caches
+
+    # exact parity against the segment-level numpy reference (~10 s host):
+    # no oracle table covers pi(10^12) - pi(10^12 - 10^9)
+    ref = CpuNumpyWorker(cfg).process_segment(lo, hi, seeds)
+    got = (cold.count, cold.twin_count, cold.first_word, cold.last_word)
+    want = (ref.count, ref.twin_count, ref.first_word, ref.last_word)
+    assert got == want, f"depth parity failure: {got} != {want}"
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        res = worker.process_segment(lo, hi, seeds)
+        best = min(best, time.perf_counter() - t0)
+        assert res.count == ref.count, "depth rerun parity failure"
+
+    values_per_sec = (hi - lo) / best
+    print(
+        json.dumps(
+            {
+                "metric": "sieve_throughput_depth_1e12_odds_pallas",
+                "value": round(values_per_sec, 1),
+                "unit": "values/s/chip",
+                "vs_baseline": round(
+                    values_per_sec / DEPTH_BASELINE_VALUES_PER_SEC, 3
+                ),
+            }
+        )
+    )
+
+
+def main() -> int:
+    shallow_metric()
+    depth_metric()
     return 0
 
 

@@ -5,6 +5,15 @@ backend; only the device path differs (sieve/kernels/pallas_mark.py). On
 non-TPU platforms (CI) the kernel runs in Pallas interpret mode, so the
 exact same kernel logic is parity-tested against cpu-numpy without TPU
 hardware.
+
+Wide strides are handled crossing-proportionally at prepare time:
+group-D specs with zero crossings of the segment are pruned (the (ND,128)
+table compacts to live rows), and strides at or above the
+SIEVE_PALLAS_FLAT_MIN cutoff skip the kernel entirely — their few
+(word, mask) crossings are host-enumerated and applied by the XLA
+postlude scatter. Both mechanisms preserve exact parity (see
+tests/test_wide_stride.py); tune the cutoff on real hardware with
+tools/profile_kernel.py.
 """
 
 from __future__ import annotations

@@ -364,7 +364,15 @@ def run_cluster(config: SieveConfig) -> SieveResult:
 
     threads: list[_WorkerConn] = []
     try:
-        deadline = time.time() + max(DEADLINE_S * 4, 300)
+        # Workload-scaled global deadline: the old fixed ~300 s cap aborted
+        # honest large-N runs. Budget assumes each worker sustains at least
+        # SIEVE_CLUSTER_FLOOR_VPS values/s (default 1e6, ~100x below the
+        # measured numpy kernel floor of 1.3e8 — see BASELINE.md), added to
+        # the fixed grace for spawn + handshake so tiny runs keep the old
+        # behavior.
+        floor_vps = float(os.environ.get("SIEVE_CLUSTER_FLOOR_VPS", "1e6"))
+        workload_s = eff.n / (floor_vps * max(1, eff.workers))
+        deadline = time.time() + max(DEADLINE_S * 4, 300) + workload_s
         while not cluster.all_done.is_set():
             if time.time() > deadline:
                 raise RuntimeError(

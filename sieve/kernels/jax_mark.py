@@ -120,9 +120,11 @@ def mark_words_impl(
 
 
 def reduce_packed(words, nbits, twin_kind: int, pair_mask,
-                  corr_idx=None, corr_mask=None):
-    """Shared tail for both device kernels: self-mark corrections, validity
-    mask beyond nbits, popcount, twin reduction, boundary words.
+                  corr_idx=None, corr_mask=None,
+                  flat_idx=None, flat_mask=None):
+    """Shared tail for both device kernels: flat wide-stride clears,
+    self-mark corrections, validity mask beyond nbits, popcount, twin
+    reduction, boundary words.
 
     ``words`` is the flat uint32 word array of one segment (padded); the
     Pallas kernel emits raw marked words and runs this as an XLA postlude
@@ -130,6 +132,16 @@ def reduce_packed(words, nbits, twin_kind: int, pair_mask,
     CC-unrolled correction loop whose live ranges blew VMEM at 1e12 scale).
     """
     w = lax.iota(jnp.int32, words.shape[0])
+
+    # --- flat crossing-list clears (pallas wide-stride path) --------------
+    # Must precede the corrections: a flat class can cross its own seed
+    # prime's bit, which the correction then re-sets. scatter-MIN because
+    # clearing only ever decreases a word, so duplicate indices — the
+    # (0, 0) padding entries colliding with a real word-0 entry — resolve
+    # to the cleared value instead of racing (scatter-set would).
+    if flat_idx is not None and flat_idx.shape[0]:
+        cur = words[flat_idx]
+        words = words.at[flat_idx].min(cur & ~flat_mask)
 
     # --- self-mark correction (seed primes inside the segment) -----------
     if corr_idx is not None and corr_idx.shape[0]:

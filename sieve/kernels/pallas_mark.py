@@ -21,16 +21,24 @@ start-free — see jax_mark.py's docstring):
     f32 reciprocal is not exact for y/m up to 2^20 when m is small).
   C (1024 < m <= 4096): one bit per word; single-level mod (q error
     < 1/8, fixed by two selects).
-  D (m > 4096 = one 128-word tile row): at most one bit per ROW, so the
-    mod runs once per (row, spec) instead of once per (word, spec) — 128
-    specs ride the lane dimension of one (R, 128) mod evaluation, and
-    each spec's single hit is placed with a compare against the lane
-    iota. Per-spec per-row cost drops from ~14 vector ops to ~4, and the
-    spec table lives in VMEM behind a fori_loop, so compile time is
+  D (4096 < m < flat cutoff): at most one bit per ROW, so the mod runs
+    once per (row, spec) instead of once per (word, spec) — 128 specs
+    ride the lane dimension of one (R, 128) mod evaluation, and each
+    spec's single hit is placed with a compare against the lane iota.
+    Per-spec per-row cost drops from ~14 vector ops to ~4, and the spec
+    table lives in VMEM behind a fori_loop, so compile time is
     independent of the spec count (the group that grows with sqrt(N)).
+    Specs with zero crossings of the window are pruned at prepare time
+    and the table compacted to live rows (see prepare_pallas).
+  flat (m >= cutoff, see _flat_cutoff): so wide that even one D-block
+    lane is a waste — the handful of (word, mask) crossings is enumerated
+    on host (specs.flat_crossings) and applied by the XLA postlude as a
+    duplicate-safe scatter-min, making their cost proportional to actual
+    crossings. Tunable via SIEVE_PALLAS_FLAT_MIN.
 
-All control flow is static or fori_loop with static bounds + act masks:
-no scatter, no gather, no data-dependent shapes.
+All in-kernel control flow is static or fori_loop with static bounds +
+act masks: no scatter, no gather, no data-dependent shapes (the flat
+scatter lives in the XLA postlude, outside the kernel).
 """
 
 from __future__ import annotations
@@ -46,7 +54,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from sieve.bitset import get_layout
-from sieve.kernels.specs import _pair_mask, tier1_specs
+from sieve.kernels.specs import _pair_mask, flat_crossings, tier1_specs
 
 import os as _os
 
@@ -65,7 +73,23 @@ B_MAX = 1024
 # setting it huge routes everything through group C (the pre-D behavior).
 D_MIN = int(_os.environ.get("SIEVE_PALLAS_DMIN", "4096"))
 D_LANES = 128                   # specs per D block (lane dimension)
+# Flat-path cutoff: strides at least this wide leave the kernel entirely —
+# their few crossings are enumerated on host (specs.flat_crossings) and
+# applied as a scatter-min in the XLA postlude, so their cost is
+# proportional to actual crossings instead of one D-block lane forever.
+# Auto (the default) keeps strides with more than _FLAT_MAX_HITS crossings
+# of the padded window in group D; the scatter only wins while the
+# crossing list stays tiny. SIEVE_PALLAS_FLAT_MIN overrides the cutoff in
+# bits (read at prepare time so tests can sweep it).
+_FLAT_MAX_HITS = 8
 _U32 = jnp.uint32
+
+
+def _flat_cutoff(Wpad: int) -> int:
+    v = int(_os.environ.get("SIEVE_PALLAS_FLAT_MIN", "0"))
+    if v <= 0:
+        v = 32 * Wpad // _FLAT_MAX_HITS
+    return max(v, max(D_MIN, 4096) + 1)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +102,8 @@ class PallasSegment:
     D: tuple[np.ndarray, ...]   # m, rK, rcp, act             each (ND, 128)
     corr_idx: np.ndarray        # (1, CC) int32 global word index (-1 pad)
     corr_mask: np.ndarray       # (1, CC) uint32
+    flat_idx: np.ndarray        # (1, FC) int32 word index of flat clears (0 pad)
+    flat_mask: np.ndarray       # (1, FC) uint32 bits to clear (0 pad = inert)
     pair_mask: int
 
 
@@ -135,16 +161,25 @@ def prepare_pallas(
     m = m.astype(np.int64)
     r = r.astype(np.int64)
     d_min = max(D_MIN, 4096)  # a D stride must exceed one 4096-bit tile row
+    f_min = _flat_cutoff(Wpad)  # > d_min: widest strides skip the kernel
     ga = m < 32
     gb = (m >= 32) & (m <= B_MAX)
     gc = (m > B_MAX) & (m <= d_min)
-    gd = m > d_min
+    gd = (m > d_min) & (m < f_min)
+    gf = m >= f_min
+    # prune: a group-D spec hits bits r, r+m, ... so a first hit at or past
+    # nbits means zero crossings of this window (only padding, masked by
+    # the postlude). Dropping it here compacts the (ND, 128) table to live
+    # rows, making the kernel's D sweep scale with crossings actually
+    # present rather than with the seed-prime count.
+    gd &= r < nbits
     if np.count_nonzero(ga) > NA_PAD:
         raise ValueError("group A overflow")
     A = _group_arrays(m[ga], r[ga], Wpad, NA_PAD, two_level=True)
     B = _group_arrays(m[gb], r[gb], Wpad, 128, two_level=True)
     C = _group_arrays(m[gc], r[gc], Wpad, 128, two_level=False)
     D = _group_d_arrays(m[gd], r[gd], Wpad)
+    fi, fm = flat_crossings(m[gf], r[gf], nbits)
 
     from sieve.kernels.specs import _corrections
 
@@ -163,8 +198,24 @@ def prepare_pallas(
         D=D,
         corr_idx=ci_pad.reshape(1, -1),
         corr_mask=cm.reshape(1, -1),
+        flat_idx=fi.reshape(1, -1),
+        flat_mask=fm.reshape(1, -1),
         pair_mask=_pair_mask(packing, lo),
     )
+
+
+def spec_counts(ps: PallasSegment) -> dict:
+    """Real (unpadded) per-tier spec counts of one prepared segment — for
+    artifacts and logs (group D reports LIVE rows post-pruning; flat
+    reports merged crossing words)."""
+    return {
+        "A": int((ps.A[5] != 0).sum()),
+        "B": int((ps.B[5] != 0).sum()),
+        "C": int((ps.C[3] != 0).sum()),
+        "D": int((ps.D[3] != 0).sum()),
+        "flat_words": int((ps.flat_mask != 0).sum()),
+        "corr_words": int((ps.corr_mask != 0).sum()),
+    }
 
 
 def _pad_fills(two_level: bool, pad_m: int) -> tuple:
@@ -192,9 +243,15 @@ def _pad_cols(arrs, fills, target: int):
     return tuple(out)
 
 
-def pad_pallas(ps: PallasSegment, SB: int, SC: int, ND: int, CC: int) -> PallasSegment:
+def pad_pallas(
+    ps: PallasSegment, SB: int, SC: int, ND: int, CC: int, FC: int | None = None
+) -> PallasSegment:
     """Pad a segment's group tables to common shapes (mesh path: all shards
-    share one compiled kernel, so spec counts must match across shards)."""
+    of a round share one compiled kernel, so spec counts must match across
+    shards — but only to the ROUND's maxima: live group-D row counts vary
+    per segment after pruning, and over-padding D re-adds the very sweep
+    cost the pruner removed). Flat crossing lists pad with (0, 0) no-ops
+    (inert under the postlude's scatter-min)."""
     D = ps.D
     pad_rows = ND - D[0].shape[0]
     if pad_rows > 0:
@@ -205,6 +262,9 @@ def pad_pallas(ps: PallasSegment, SB: int, SC: int, ND: int, CC: int) -> PallasS
             for a, fill in zip(D, _PAD_D)
         )
     ci, cm = _pad_cols((ps.corr_idx, ps.corr_mask), (-1, 0), CC)
+    fi, fm = ps.flat_idx, ps.flat_mask
+    if FC is not None and FC > fi.shape[1]:
+        fi, fm = _pad_cols((fi, fm), (0, 0), FC)
     return dataclasses.replace(
         ps,
         B=_pad_cols(ps.B, _PAD_B, SB),
@@ -212,6 +272,8 @@ def pad_pallas(ps: PallasSegment, SB: int, SC: int, ND: int, CC: int) -> PallasS
         D=D,
         corr_idx=ci,
         corr_mask=cm,
+        flat_idx=fi,
+        flat_mask=fm,
     )
 
 
@@ -313,14 +375,19 @@ def _make_kernel(SB: int, SC: int, ND: int):
                 # Placement: the hit of the spec riding lane s belongs at
                 # lane hw[r, s]. Rotating lanes right by k moves lane s to
                 # lane s + k, so the spec's contribution rides rotation
-                # k = (hw - s) mod 128: select it, roll, OR. 128 full-width
-                # rotations, no lane slicing, tiny live state (VMEM-stack
-                # friendly), and compile cost independent of ND.
+                # k = (hw - s) mod 128. OR_k roll(sel_k, k) is evaluated
+                # Horner-style: descending k, rotate the accumulator one
+                # lane and OR in this k's selection — sel_k ends up rotated
+                # exactly k times. Same select count as rotate-by-k, but
+                # every rotation is the cheapest (distance-1) lane shuffle;
+                # still no lane slicing, tiny live state, compile cost
+                # independent of ND.
                 dist = (hw - lane) & 127
-                hit = jnp.zeros((R_ROWS, 128), _U32)
-                for k in range(D_LANES):
-                    contrib = jnp.where(dist == k, hmask, _U32(0))
-                    hit = hit | pltpu.roll(contrib, k, axis=1)
+                hit = jnp.where(dist == D_LANES - 1, hmask, _U32(0))
+                for k in range(D_LANES - 2, -1, -1):
+                    hit = pltpu.roll(hit, 1, axis=1) | jnp.where(
+                        dist == k, hmask, _U32(0)
+                    )
                 return ws & ~hit
 
             words = lax.fori_loop(0, ND, dbody, words)
@@ -371,24 +438,27 @@ def _build_call(Wpad: int, SB: int, SC: int, ND: int, interpret: bool):
     return call
 
 
-def _postlude(words, nbits, pair_mask, ci, cm, twin_kind: int):
-    """XLA tail on the kernel's words: corrections + reductions."""
+def _postlude(words, nbits, pair_mask, ci, cm, twin_kind: int,
+              fi=None, fm=None):
+    """XLA tail on the kernel's words: flat clears + corrections +
+    reductions."""
     from sieve.kernels.jax_mark import reduce_packed
 
     return reduce_packed(
-        words.reshape(-1), nbits, twin_kind, pair_mask, ci, cm
+        words.reshape(-1), nbits, twin_kind, pair_mask, ci, cm, fi, fm
     )
 
 
 @functools.lru_cache(maxsize=None)
-def _build_call_jit(Wpad, twin_kind, SB, SC, ND, interpret):
+def _build_call_jit(Wpad, twin_kind, SB, SC, ND, FC, interpret):
     call = _build_call(Wpad, SB, SC, ND, interpret)
 
-    def run(nbits, pmask, A_B_C_D_args, ci, cm):
+    def run(nbits, pmask, A_B_C_D_args, ci, cm, fi, fm):
         from sieve.kernels.jax_mark import pack4
 
         words = call(*A_B_C_D_args)
-        return pack4(*_postlude(words, nbits, pmask, ci, cm, twin_kind))
+        return pack4(*_postlude(words, nbits, pmask, ci, cm, twin_kind,
+                                fi, fm))
 
     return jax.jit(run, static_argnames=())
 
@@ -400,12 +470,15 @@ def mark_pallas(ps: PallasSegment, twin_kind: int, interpret: bool):
     SB = ps.B[0].shape[1]
     SC = ps.C[0].shape[1]
     ND = ps.D[0].shape[0] if ps.D[3].any() else 0
-    call = _build_call_jit(ps.Wpad, twin_kind, SB, SC, ND, interpret)
+    FC = ps.flat_idx.shape[1] if ps.flat_mask.any() else 0
+    call = _build_call_jit(ps.Wpad, twin_kind, SB, SC, ND, FC, interpret)
     packed = np.asarray(call(
         np.int32(ps.nbits),
         np.uint32(ps.pair_mask),
         tuple(ps.A) + tuple(ps.B) + tuple(ps.C) + tuple(ps.D),
         ps.corr_idx[0],
         ps.corr_mask[0],
+        ps.flat_idx[0, :FC],
+        ps.flat_mask[0, :FC],
     ))  # one uint32[4] fetch: count, twins, first, last
     return int(packed[0]), int(packed[1]), int(packed[2]), int(packed[3])

@@ -239,6 +239,50 @@ def _corrections(
     )
 
 
+def flat_crossings(
+    m: np.ndarray, r: np.ndarray, nbits: int, pad_to: int = 128
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-enumerated crossing list for very wide strides (the pallas
+    flat path): every bit {r, r+m, r+2m, ...} < nbits of every spec,
+    merged into per-word (word_idx, clear_mask) pairs — the same
+    enumerate-and-merge idiom as ``_corrections``, but for clears instead
+    of re-sets. Same start-free contract as the kernel groups (bits below
+    p^2 are composites a smaller prime already marks; the seed's own bit
+    is re-set by the corrections that run after these clears).
+
+    Padded with (0, 0) entries: a zero mask clears nothing, so padding is
+    inert under the postlude's scatter-min (see jax_mark.reduce_packed).
+    """
+    m = np.asarray(m, np.int64)
+    r = np.asarray(r, np.int64)
+    counts = np.maximum(0, -(-(nbits - r) // np.maximum(m, 1)))
+    tot = int(counts.sum())
+    if tot:
+        spec = np.repeat(np.arange(m.size), counts)
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        offs = np.arange(tot) - np.repeat(starts, counts)
+        bits = r[spec] + offs * m[spec]
+        words = bits >> 5
+        masks = (np.uint32(1) << (bits & 31).astype(np.uint32))
+        order = np.argsort(words, kind="stable")
+        ws, ms = words[order], masks[order]
+        new = np.empty(tot, bool)
+        new[0] = True
+        new[1:] = ws[1:] != ws[:-1]
+        grp = np.flatnonzero(new)
+        idx = ws[grp].astype(np.int32)
+        msk = np.bitwise_or.reduceat(ms, grp)
+    else:
+        idx = np.zeros(0, np.int32)
+        msk = np.zeros(0, np.uint32)
+    F = max(pad_to, -(-idx.size // pad_to) * pad_to)
+    pad = F - idx.size
+    return (
+        np.concatenate([idx, np.zeros(pad, np.int32)]),
+        np.concatenate([msk, np.zeros(pad, np.uint32)]),
+    )
+
+
 def _pair_mask(packing: str, lo: int) -> int:
     """uint32 mask of bit positions whose (b, b+shift) pair is a twin pair."""
     if packing != "wheel30":

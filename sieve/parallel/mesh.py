@@ -14,9 +14,12 @@ interpret mode on CPU meshes so CI covers it without TPU hardware).
 
 Rounds (``--rounds k``) split the run into k sequential dispatches of one
 segment per device each: the failure-recovery / beyond-HBM streaming
-granularity of SURVEY.md sections 5.3 and 5.7. All rounds share one
-compiled step (tier-1 period set is hi-independent by construction, and
-every other shape is bucketed).
+granularity of SURVEY.md sections 5.3 and 5.7. The word-kernel path
+shares one compiled step across all rounds; the pallas path compiles one
+step per distinct ROUND shape bucket (live group-D spec rows and flat
+crossing-list lengths vary per round once zero-crossing specs are pruned
+— padding every round to the global maximum would re-add the pruned
+sweep cost), with shard shapes still static within a round.
 """
 
 from __future__ import annotations
@@ -47,6 +50,16 @@ from sieve.segments import plan_segments, validate_plan
 from sieve.worker import SegmentResult
 
 MIN_SHARD_BITS = 64
+# group-D row-count bucket for the per-round pallas step cache: padding
+# within a bucket costs at most ND_BUCKET-1 inert (but swept) spec blocks,
+# while bounding the number of distinct compiles across rounds
+ND_BUCKET = 8
+
+
+class MeshCrossCheckError(RuntimeError):
+    """The ICI-collective totals (psum / ppermute straddle) disagree with
+    the host-side merge semantics — data corruption or a collective bug.
+    A real exception (not an assert) so the check survives ``python -O``."""
 
 
 def _shard_map():
@@ -200,12 +213,13 @@ def _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs):
 
 @functools.lru_cache(maxsize=None)
 def _make_pallas_step(mesh_key, Wpad: int, twin_kind: int, SB: int, SC: int,
-                      ND: int, CC: int, ndev: int, interpret: bool):
+                      ND: int, CC: int, FC: int, ndev: int, interpret: bool):
     """Jitted one-round step running the fused Pallas kernel per shard —
     the north-star composition (SURVEY.md section 3.3): pallas_call inside
     shard_map, counts merged with lax.psum and boundary bits exchanged
     with lax.ppermute over ICI. On CPU meshes the kernel runs in interpret
-    mode, so the multi-chip path is CI-testable without TPU hardware."""
+    mode, so the multi-chip path is CI-testable without TPU hardware.
+    Cached per (ND, FC, ...) round shape bucket — see run_mesh."""
     from jax.sharding import PartitionSpec as P
 
     from sieve.kernels.pallas_mark import _build_call, _postlude
@@ -216,14 +230,16 @@ def _make_pallas_step(mesh_key, Wpad: int, twin_kind: int, SB: int, SC: int,
 
     def shard_fn(nbits, pmask, *rest):
         groups = tuple(a[0] for a in rest[:20])   # A(6) + B(6) + C(4) + D(4)
-        ci, cm, gap_ok = rest[20][0, 0], rest[21][0, 0], rest[22]
+        ci, cm = rest[20][0, 0], rest[21][0, 0]
+        fi, fm = rest[22][0, 0], rest[23][0, 0]
+        gap_ok = rest[24]
         words = call(*groups)
         count, twins, first32, last32 = _postlude(
-            words, nbits[0, 0, 0], pmask[0, 0, 0], ci, cm, twin_kind
+            words, nbits[0, 0, 0], pmask[0, 0, 0], ci, cm, twin_kind, fi, fm
         )
         return _collective_merge(count, twins, first32, last32, gap_ok, ndev)
 
-    in_specs = (P("seg"),) * 25
+    in_specs = (P("seg"),) * 27
     out_specs = P()  # one packed replicated vector (see _collective_merge)
     return _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs)
 
@@ -294,8 +310,15 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
 
     seeds = seed_primes(cfg.seed_limit)
     twin_kind = TWIN_KIND[cfg.packing] if cfg.twins else TWIN_NONE
-    # shared shape buckets across ALL shards and rounds -> one compile
     if use_pallas:
+        # Wpad/SB/SC/CC are shared across ALL shards and rounds (Wpad is
+        # baked into every spec's rK offset, so it must be fixed before
+        # grouping; B/C/correction counts barely vary). ND and FC are
+        # padded per ROUND instead: live group-D rows (post-pruning) and
+        # flat crossing lists shrink as rounds move to windows the wide
+        # strides barely cross, and padding them to the global max would
+        # re-add exactly the sweep cost the pruner removed. The per-round
+        # step is lru_cached by its (ND, FC) bucket.
         from sieve.kernels.pallas_mark import (
             TILE_WORDS,
             pad_pallas,
@@ -310,15 +333,9 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
         ]
         SB = max(p.B[0].shape[1] for p in prep0)
         SC = max(p.C[0].shape[1] for p in prep0)
-        ND = max(
-            (p.D[0].shape[0] if p.D[3].any() else 0) for p in prep0
-        )
         CC = max(p.corr_idx.shape[1] for p in prep0)
-        prep0 = [pad_pallas(p, SB, SC, max(ND, 1), CC) for p in prep0]
         interpret = mesh.devices.flat[0].platform == "cpu"
-        step = _make_pallas_step(
-            mesh_key, Wpad, twin_kind, SB, SC, ND, CC, ndev, interpret
-        )
+        step = None  # built per round (shape-bucketed) in the loop below
     else:
         prep0 = [
             prepare_tiered(cfg.packing, s.lo, s.hi, seeds,
@@ -341,7 +358,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
     import jax
 
     multihost = jax.process_count() > 1
-    if multihost:
+    if multihost and step is not None:
         raw_step = step
         step = lambda *args: raw_step(*_globalize(mesh, args))
 
@@ -402,7 +419,11 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
         # cross-check: the ICI-collective totals agree with the host-side
         # merge semantics (psum for counts; psum + ppermute straddle for
         # the odds twin path — the transport this path exists to exercise)
-        assert total == int(counts.sum()), "psum/count mismatch"
+        if total != int(counts.sum()):
+            raise MeshCrossCheckError(
+                f"psum/count mismatch: collective total {total} != "
+                f"host sum {int(counts.sum())}"
+            )
         if cfg.twins and cfg.packing == "odds":
             from sieve.twins import straddle_twins
 
@@ -411,9 +432,10 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                 straddle_twins(layout, a, b, cfg.n)
                 for a, b in zip(batch_res, batch_res[1:])
             )
-            assert total_twins == expect, (
-                f"ppermute twin path diverged: {total_twins} != {expect}"
-            )
+            if total_twins != expect:
+                raise MeshCrossCheckError(
+                    f"ppermute twin path diverged: {total_twins} != {expect}"
+                )
 
     for rnd in range(max(1, cfg.rounds)):
         batch = segs[rnd * ndev : (rnd + 1) * ndev]
@@ -432,6 +454,19 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                 if fv - lv == 2 and fv <= cfg.n:
                     gap_ok[i] = 1
         if use_pallas:
+            # round-max shared shapes (bucketed -> bounded recompiles)
+            nd = max((p.D[0].shape[0] if p.D[3].any() else 0) for p in preps)
+            ND_r = -(-nd // ND_BUCKET) * ND_BUCKET
+            FC_r = max(p.flat_idx.shape[1] for p in preps)
+            preps = [
+                pad_pallas(p, SB, SC, max(ND_r, 1), CC, FC_r) for p in preps
+            ]
+            rstep = _make_pallas_step(
+                mesh_key, Wpad, twin_kind, SB, SC, ND_r, CC, FC_r, ndev,
+                interpret,
+            )
+            if multihost:
+                rstep = (lambda *a, _r=rstep: _r(*_globalize(mesh, a)))
             groups = [
                 np.stack([p.A[i] for p in preps]) for i in range(6)
             ] + [
@@ -441,7 +476,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
             ] + [
                 np.stack([p.D[i] for p in preps]) for i in range(4)
             ]
-            out = step(
+            out = rstep(
                 nbits_v.reshape(-1, 1, 1),
                 np.array(
                     [p.pair_mask for p in preps], np.uint32
@@ -449,6 +484,8 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                 *groups,
                 np.stack([p.corr_idx for p in preps]),
                 np.stack([p.corr_mask for p in preps]),
+                np.stack([p.flat_idx for p in preps]),
+                np.stack([p.flat_mask for p in preps]),
                 gap_ok,
             )
         else:

@@ -1,0 +1,165 @@
+"""Coverage for crossing-proportional wide-stride marking: the group-D
+zero-crossing pruner, the flat crossing-list path, the cutoff boundary
+between the two mechanisms, the 8-way mesh with live group D, and the
+ASan build of the native kernel (subprocess, so the env switch takes
+effect before the library loads).
+"""
+
+import dataclasses
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from sieve.config import SieveConfig
+from sieve.seed import seed_primes
+
+# test_pallas_group_d_parity's segment: seed primes up to 5477, so strides
+# in (4096, 5477] populate group D / the flat path
+N_D = 30_000_000
+LO_D, HI_D = 2_000_003, 24_000_001
+
+
+def _segment(backend, lo, hi, n, seeds=None):
+    from sieve.backends import make_worker
+
+    cfg = SieveConfig(n=n, backend=backend, packing="odds", twins=True,
+                      quiet=True)
+    w = make_worker(cfg)
+    if seeds is None:
+        seeds = seed_primes(cfg.seed_limit)
+    try:
+        d = dataclasses.asdict(w.process_segment(lo, hi, seeds))
+    finally:
+        w.close()
+    d.pop("elapsed_s")
+    return d
+
+
+@pytest.fixture(scope="module")
+def ref_d():
+    return _segment("cpu-numpy", LO_D, HI_D, N_D)
+
+
+# Cutoff boundary values against the stride population {4099, ..., 5477}:
+# 4097 routes EVERY group-D stride through the flat crossing list (ND=0);
+# 5477 routes exactly the widest stride flat (>= comparison, lower edge);
+# 5478 leaves flat empty again (upper edge — pure pruned-D behavior).
+@pytest.mark.parametrize("flat_min", [4097, 5477, 5478])
+def test_flat_cutoff_parity(monkeypatch, ref_d, flat_min):
+    from sieve.kernels.pallas_mark import _flat_cutoff, prepare_pallas, spec_counts
+
+    monkeypatch.setenv("SIEVE_PALLAS_FLAT_MIN", str(flat_min))
+    ps = prepare_pallas("odds", LO_D, HI_D, seed_primes(5477))
+    counts = spec_counts(ps)
+    n_wide = int(np.sum(seed_primes(5477) >= max(flat_min, 4099)))
+    if flat_min <= 5477:
+        assert counts["flat_words"] > 0 and n_wide > 0
+    else:
+        assert counts["flat_words"] == 0
+    assert _flat_cutoff(ps.Wpad) == flat_min
+    got = _segment("tpu-pallas", LO_D, HI_D, N_D)
+    assert got == ref_d, f"flat_min={flat_min}"
+
+
+def test_prune_zero_crossing_specs():
+    """A window far narrower than the widest strides: specs whose first
+    hit lies beyond nbits must be dropped and the D table compacted to
+    exactly the live rows — with parity intact."""
+    from sieve.kernels.pallas_mark import _flat_cutoff, prepare_pallas, spec_counts
+    from sieve.kernels.specs import tier1_specs
+
+    n = 10**9  # seeds up to 31623 -> strides up to 31607 bits
+    lo, hi = 500_000_001, 500_040_001  # 40k values = 20k bits << max stride
+    seeds = seed_primes(31623)
+    ps = prepare_pallas("odds", lo, hi, seeds)
+    m, r = tier1_specs("odds", lo, seeds, tier1_max=1 << 62)
+    f_min = _flat_cutoff(ps.Wpad)
+    in_d = (m > 4096) & (m < f_min)
+    live = int(np.sum(in_d & (r < ps.nbits)))
+    assert live < int(np.sum(in_d)), "window admits no pruning — bad fixture"
+    assert spec_counts(ps)["D"] == live
+    # compacted: every surviving row has at least one active lane
+    assert all(ps.D[3][i].any() for i in range(ps.D[0].shape[0]))
+    got = _segment("tpu-pallas", lo, hi, n, seeds)
+    assert got == _segment("cpu-numpy", lo, hi, n, seeds)
+
+
+def test_flat_crossings_merges_duplicates():
+    from sieve.kernels.specs import flat_crossings
+
+    # two specs crossing the same words: masks must OR-merge per word
+    m = np.array([70_000, 70_003], np.int64)
+    r = np.array([5, 9], np.int64)
+    idx, msk = flat_crossings(m, r, nbits=100_000)
+    real = msk != 0
+    # crossings: bits {5, 70005} and {9, 70012} -> words {0, 2187} each
+    assert idx[real].tolist() == [0, 2187]
+    assert msk[real][0] == (1 << 5) | (1 << 9)
+    assert msk[real][1] == (1 << (70_005 % 32)) | (1 << (70_012 % 32))
+    assert idx.size % 128 == 0
+
+
+def test_mesh_group_d_8way():
+    """8-way CPU mesh, 2 rounds, n large enough that group D is live in
+    every shard — the sharded counterpart of test_pallas_group_d_parity
+    (and the regression net for per-round ND/FC shape padding)."""
+    from sieve.parallel.mesh import run_mesh
+
+    cfg = SieveConfig(n=N_D, backend="tpu-pallas", packing="odds",
+                      workers=8, rounds=2, twins=True, quiet=True)
+    res = run_mesh(cfg)
+    # oracle computed 2026-08-05 by an independent numpy sieve (consistent
+    # with BASELINE.md's table at the bracketing powers of ten)
+    assert res.pi == 1_857_859
+    assert res.twin_pairs == 152_891
+
+
+def test_asan_native_parity():
+    """The wired-but-never-run ASan build: run one native-vs-numpy parity
+    check in a subprocess with SIEVE_NATIVE_ASAN=1 (the env must be set
+    before the library loads, and the asan runtime must be preloaded into
+    the non-instrumented python)."""
+    pytest.importorskip("sieve.backends.cpu_native")
+    libasan = subprocess.run(
+        ["g++", "-print-file-name=libasan.so"],
+        capture_output=True, text=True,
+    ).stdout.strip()
+    if not libasan or not os.path.exists(libasan):
+        pytest.skip("libasan.so not found")
+    code = (
+        "import dataclasses\n"
+        "from sieve.config import SieveConfig\n"
+        "from sieve.backends.cpu_native import CpuNativeWorker\n"
+        "from sieve.backends.cpu_numpy import CpuNumpyWorker\n"
+        "from sieve.seed import seed_primes\n"
+        "cfg = SieveConfig(n=10**6, backend='cpu-native', packing='odds',\n"
+        "                  twins=True, quiet=True)\n"
+        "seeds = seed_primes(cfg.seed_limit)\n"
+        "strip = lambda r: {k: v for k, v in dataclasses.asdict(r).items()\n"
+        "                   if k != 'elapsed_s'}\n"
+        "a = CpuNativeWorker(cfg).process_segment(101, 400001, seeds)\n"
+        "b = CpuNumpyWorker(cfg).process_segment(101, 400001, seeds)\n"
+        "assert strip(a) == strip(b), (a, b)\n"
+        "print('ASAN_PARITY_OK')\n"
+    )
+    env = {
+        **os.environ,
+        "SIEVE_NATIVE_ASAN": "1",
+        "LD_PRELOAD": libasan,
+        # python itself is not asan-instrumented; its allocations look like
+        # leaks and would fail the exit hook
+        "ASAN_OPTIONS": "detect_leaks=0",
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+    )
+    if proc.returncode != 0 and "cannot" in proc.stderr.lower():
+        pytest.skip(f"asan runtime unusable here: {proc.stderr[-200:]}")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ASAN_PARITY_OK" in proc.stdout

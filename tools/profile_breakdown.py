@@ -62,9 +62,11 @@ def main():
     print(f"pallas kernel only (device):{dt*1e3:9.1f} ms")
 
     # kernel + postlude (the full mark_pallas jit), warm
-    full = _build_call_jit(ps.Wpad, 1, SB, SC, ND, False)
+    FC = ps.flat_idx.shape[1] if ps.flat_mask.any() else 0
+    full = _build_call_jit(ps.Wpad, 1, SB, SC, ND, FC, False)
     fargs = (np.int32(ps.nbits), np.uint32(ps.pair_mask), args,
-             ps.corr_idx[0], ps.corr_mask[0])
+             ps.corr_idx[0], ps.corr_mask[0],
+             ps.flat_idx[0, :FC], ps.flat_mask[0, :FC])
     jax.block_until_ready(full(*fargs))
     dt, _ = t(lambda: jax.block_until_ready(full(*fargs)))
     print(f"kernel + postlude (device): {dt*1e3:9.1f} ms")

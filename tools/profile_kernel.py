@@ -1,7 +1,17 @@
 """True device time of the pallas kernel: chain k calls with DISTINCT
 inputs (defeats CSE), one final reduced fetch. Slope over k = kernel time.
 Also times the postlude alone the same way.
-Usage: python tools/profile_kernel.py [n]"""
+
+Usage: python tools/profile_kernel.py [span] [lo]
+
+    span  window size in values (default 1e9)
+    lo    window start (default 2) — the 10^12-depth probe that exposed
+          the group-D regime collapse (VERDICT.md round 5) is:
+
+              python tools/profile_kernel.py 1e9 999000000000
+
+          (full 78,498-seed set, ND=609 live group-D blocks)
+"""
 
 from __future__ import annotations
 
@@ -31,15 +41,23 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from sieve.kernels.pallas_mark import _build_call, _postlude, prepare_pallas
+    from sieve.kernels.pallas_mark import (
+        _build_call,
+        _postlude,
+        prepare_pallas,
+        spec_counts,
+    )
     from sieve.seed import seed_primes
 
-    n = int(float(sys.argv[1])) if len(sys.argv) > 1 else 10**9
-    seeds = seed_primes(math.isqrt(n))
-    ps = prepare_pallas("odds", 2, n + 1, seeds)
+    span = int(float(sys.argv[1])) if len(sys.argv) > 1 else 10**9
+    lo = int(float(sys.argv[2])) if len(sys.argv) > 2 else 2
+    hi = lo + span
+    seeds = seed_primes(math.isqrt(hi - 1))
+    ps = prepare_pallas("odds", lo, hi, seeds)
     SB, SC = ps.B[0].shape[1], ps.C[0].shape[1]
     ND = ps.D[0].shape[0] if ps.D[3].any() else 0
-    print(f"n={n:.0e} Wpad={ps.Wpad} SB={SB} SC={SC} ND={ND}")
+    print(f"[{lo:.3e}, {hi:.3e}) Wpad={ps.Wpad} SB={SB} SC={SC} ND={ND} "
+          f"tiers={spec_counts(ps)}")
     call = _build_call(ps.Wpad, SB, SC, ND, interpret=False)
     base = tuple(ps.A) + tuple(ps.B) + tuple(ps.C) + tuple(ps.D)
 
@@ -84,7 +102,8 @@ def main():
     print(f"--> kernel device time: {kt*1e3:8.1f} ms "
           f"({2 * ps.nbits / kt:.3e} values/s)")
 
-    # postlude alone: run kernel once, postlude k times on perturbed words
+    # postlude alone (includes the flat crossing-list scatter): run kernel
+    # once, postlude k times on perturbed words
     def post_chain(k):
         a = base
 
@@ -96,7 +115,7 @@ def main():
                 c, t, f, l = _postlude(
                     w ^ jnp.uint32(i), np.int32(ps.nbits),
                     np.uint32(ps.pair_mask), ps.corr_idx[0],
-                    ps.corr_mask[0], 1)
+                    ps.corr_mask[0], 1, ps.flat_idx[0], ps.flat_mask[0])
                 acc = acc + c.astype(jnp.uint32)
             return acc
 

@@ -55,7 +55,8 @@ def main():
                 w = call(*a)
                 c, tw, fw, lw = _postlude(
                     w, np.int32(ps.nbits), np.uint32(ps.pair_mask),
-                    ps.corr_idx[0], ps.corr_mask[0], 1)
+                    ps.corr_idx[0], ps.corr_mask[0], 1,
+                    ps.flat_idx[0], ps.flat_mask[0])
                 acc = acc + c.astype(jnp.uint32)
             return acc
 
@@ -84,10 +85,12 @@ def main2():
     ps = prepare_pallas("odds", 2, n + 1, seeds)
     SB, SC = ps.B[0].shape[1], ps.C[0].shape[1]
     ND = ps.D[0].shape[0] if ps.D[3].any() else 0
-    full = _build_call_jit(ps.Wpad, 1, SB, SC, ND, False)
+    FC = ps.flat_idx.shape[1] if ps.flat_mask.any() else 0
+    full = _build_call_jit(ps.Wpad, 1, SB, SC, ND, FC, False)
     host_args = (np.int32(ps.nbits), np.uint32(ps.pair_mask),
                  tuple(ps.A) + tuple(ps.B) + tuple(ps.C) + tuple(ps.D),
-                 ps.corr_idx[0], ps.corr_mask[0])
+                 ps.corr_idx[0], ps.corr_mask[0],
+                 ps.flat_idx[0, :FC], ps.flat_mask[0, :FC])
     dev_args = jax.device_put(host_args)
     jax.block_until_ready(dev_args)
     for label, args in (("host args", host_args), ("device args", dev_args)):
