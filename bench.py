@@ -1,6 +1,7 @@
-"""Benchmark: the shallow AND depth regimes of the pallas sieve.
+"""Benchmark: the shallow AND depth regimes of the pallas sieve, plus the
+host-prepare pipeline.
 
-Prints TWO JSON lines {"metric", "value", "unit", "vs_baseline"}:
+Prints THREE JSON lines {"metric", "value", "unit", "vs_baseline"}:
 
 1. pi(1e9), odds packing, tpu-pallas backend — the shallow regime.
    Baseline: BASELINE.md's measured CPU floor — pi(1e9) segmented numpy
@@ -12,6 +13,12 @@ Prints TWO JSON lines {"metric", "value", "unit", "vs_baseline"}:
    values/s/chip probe measured on v5e (VERDICT.md round 5). Emitted on
    TPU only (interpret mode would take hours); force with
    SIEVE_BENCH_DEPTH=1.
+3. Host-prepare throughput of the incremental chain (specs off the
+   critical path): steady-state PallasChain values/s over depth-regime
+   segments with the full seed set. vs_baseline = speedup over
+   from-scratch prepare_pallas of the same segments. The line also
+   carries overlap_efficiency / device_idle_frac measured from a real
+   streamed mesh round loop. Host-only: emitted on any platform.
 
 Exact parity is asserted before any number is printed — the depth line
 against a cpu-numpy run of the same segment: a fast wrong sieve scores
@@ -130,9 +137,72 @@ def depth_metric() -> None:
     )
 
 
+def host_prepare_metric() -> None:
+    """Host-only line (runs on any platform): steady-state incremental
+    chain-prepare throughput at depth-regime stride density, vs from-scratch
+    prepare_pallas of the same segments, plus overlap efficiency / device
+    idle fraction from a real streamed mesh round loop."""
+    from sieve.bitset import get_layout
+    from sieve.config import SieveConfig
+    from sieve.kernels.pallas_mark import (
+        TILE_WORDS,
+        PallasChain,
+        prepare_pallas,
+    )
+    from sieve.parallel.mesh import run_mesh
+    from sieve.seed import seed_primes
+
+    span = 10**8
+    k = 9  # segment 0 initializes the chain; 1..k-1 are timed steady state
+    seeds = seed_primes(math.isqrt(DEPTH_HI - 1))  # full 78,498-seed set
+    layout = get_layout("odds")
+    bounds = [
+        (DEPTH_LO + i * span, DEPTH_LO + (i + 1) * span) for i in range(k)
+    ]
+    W = max(-(-layout.nbits(lo, hi) // 32) for lo, hi in bounds)
+    wpad = -(-(W + 1) // TILE_WORDS) * TILE_WORDS
+
+    chain = PallasChain("odds", seeds, wpad)
+    chain.prepare(*bounds[0])  # one-time from-scratch residue derivation
+    t0 = time.perf_counter()
+    for lo, hi in bounds[1:]:
+        chain.prepare(lo, hi)
+    incr_per_seg = (time.perf_counter() - t0) / (k - 1)
+
+    t0 = time.perf_counter()
+    for lo, hi in bounds[1:3]:
+        prepare_pallas("odds", lo, hi, seeds, wpad=wpad)
+    scratch_per_seg = (time.perf_counter() - t0) / 2
+
+    # overlap efficiency: a real streamed run (background prepare threads
+    # feeding the round loop) on whatever device this host has
+    cfg = SieveConfig(
+        n=30_000_000, backend="jax", packing="odds", workers=1, rounds=6,
+        twins=False, quiet=True,
+    )
+    res = run_mesh(cfg)
+    assert res.pi == 1_857_859, f"mesh parity failure: {res.pi}"
+    ph = res.host_phases or {}
+
+    print(
+        json.dumps(
+            {
+                "metric": "host_prepare_throughput_odds_pallas",
+                "value": round(span / incr_per_seg, 1),
+                "unit": "values/s",
+                # speedup of incremental chain prepare over from-scratch
+                "vs_baseline": round(scratch_per_seg / incr_per_seg, 3),
+                "overlap_efficiency": ph.get("overlap_efficiency"),
+                "device_idle_frac": ph.get("device_idle_frac"),
+            }
+        )
+    )
+
+
 def main() -> int:
     shallow_metric()
     depth_metric()
+    host_prepare_metric()
     return 0
 
 

@@ -31,7 +31,7 @@ from sieve.kernels.jax_mark import (
     mark_words,
     next_pow2,
 )
-from sieve.kernels.specs import prepare_tiered
+from sieve.kernels.specs import TieredChain, prepare_tiered
 from sieve.worker import SegmentResult, SieveWorker
 
 TWIN_KIND = {"plain": TWIN_PLAIN, "odds": TWIN_ADJ, "wheel30": TWIN_W30}
@@ -62,11 +62,28 @@ class JaxWorker(SieveWorker):
         platform = os.environ.get("SIEVE_JAX_PLATFORM")
         self._device = jax.devices(platform)[0] if platform else None
         self._cpu_fallback = CpuNumpyWorker(config)
+        self._chain: TieredChain | None = None
+        self._chain_seeds: np.ndarray | None = None
 
     def _placement(self):
         if self._device is None:
             return contextlib.nullcontext()
         return self._jax.default_device(self._device)
+
+    def _prepare(self, packing: str, lo: int, hi: int, seeds: np.ndarray):
+        """Incremental per-worker prepare: segments of one run arrive in
+        order, so residues advance O(1) per seed instead of re-deriving
+        from scratch (exact for arbitrary jumps; see specs.TieredChain)."""
+        if self._chain is None or self._chain_seeds is not seeds:
+            self._chain = TieredChain(
+                packing, seeds,
+                tier1_max=TIER1_MAX, spec_block=SPEC_BLOCK,
+                word_bucket=WORD_BUCKET,
+            )
+            self._chain_seeds = seeds
+            self.phase_seconds = self._chain.phase_seconds
+        ts = self._chain.prepare(lo, hi)
+        return ts.with_spec_count(max(SPEC_BLOCK, next_pow2(ts.m2.size)))
 
     def process_segment(
         self, lo: int, hi: int, seed_primes: np.ndarray, seg_id: int = 0
@@ -78,7 +95,7 @@ class JaxWorker(SieveWorker):
         if nbits < MIN_DEVICE_BITS:
             return self._cpu_fallback.process_segment(lo, hi, seed_primes, seg_id)
 
-        ts = prepare_segment(packing, lo, hi, seed_primes)
+        ts = self._prepare(packing, lo, hi, seed_primes)
         twin_kind = TWIN_KIND[packing] if self.config.twins else TWIN_NONE
         with self._placement():
             packed = np.asarray(mark_words(

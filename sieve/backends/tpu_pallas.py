@@ -28,7 +28,7 @@ from sieve.backends.cpu_numpy import CpuNumpyWorker
 from sieve.backends.jax_backend import MIN_DEVICE_BITS, TWIN_KIND
 from sieve.bitset import get_layout
 from sieve.kernels.jax_mark import TWIN_NONE
-from sieve.kernels.pallas_mark import mark_pallas, prepare_pallas
+from sieve.kernels.pallas_mark import TILE_WORDS, PallasChain, mark_pallas
 from sieve.worker import SegmentResult, SieveWorker
 
 
@@ -44,11 +44,34 @@ class PallasWorker(SieveWorker):
         self._device = jax.devices(platform)[0] if platform else jax.devices()[0]
         self._interpret = self._device.platform == "cpu"
         self._cpu_fallback = CpuNumpyWorker(config)
+        self._chains: dict[int, PallasChain] = {}  # keyed by padded width
+        self._chain_seeds: np.ndarray | None = None
 
     def _placement(self):
         if self._device is None:
             return contextlib.nullcontext()
         return self._jax.default_device(self._device)
+
+    def _prepare(self, packing: str, lo: int, hi: int, seeds: np.ndarray):
+        """Incremental per-worker prepare (see specs.SpecChain): one chain
+        per padded width — a run's equal-sized segments share one chain, so
+        residues advance O(1) per seed instead of being re-derived."""
+        if self._chain_seeds is not seeds:
+            self._chains.clear()
+            self._chain_seeds = seeds
+        layout = get_layout(packing)
+        W = -(-layout.nbits(lo, hi) // 32)
+        wpad = -(-(W + 1) // TILE_WORDS) * TILE_WORDS
+        chain = self._chains.get(wpad)
+        if chain is None:
+            chain = self._chains[wpad] = PallasChain(packing, seeds, wpad)
+        ps = chain.prepare(lo, hi)
+        agg: dict[str, float] = {}
+        for c in self._chains.values():
+            for k, v in c.phase_seconds.items():
+                agg[k] = agg.get(k, 0.0) + v
+        self.phase_seconds = agg
+        return ps
 
     def process_segment(
         self, lo: int, hi: int, seed_primes: np.ndarray, seg_id: int = 0
@@ -60,7 +83,7 @@ class PallasWorker(SieveWorker):
         if nbits < MIN_DEVICE_BITS:
             return self._cpu_fallback.process_segment(lo, hi, seed_primes, seg_id)
 
-        ps = prepare_pallas(packing, lo, hi, seed_primes)
+        ps = self._prepare(packing, lo, hi, seed_primes)
         twin_kind = TWIN_KIND[packing] if self.config.twins else TWIN_NONE
         with self._placement():
             count, twins, first_word, last_word = mark_pallas(

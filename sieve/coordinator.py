@@ -38,6 +38,10 @@ class SieveResult:
     elapsed_s: float
     values_per_sec: float
     segments: list[SegmentResult] = dataclasses.field(default_factory=list)
+    # host prepare / overlap metrics (mesh streaming pipeline; local runs
+    # carry the worker's incremental-prepare phase totals) — optional so
+    # callers predating the pipeline keep working
+    host_phases: dict | None = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -132,6 +136,15 @@ class Coordinator:
         results = [done[s.seg_id] for s in segs]
         pi, twins = merge_results(cfg, results)
         elapsed = time.perf_counter() - t0
+        phases = getattr(worker, "phase_seconds", None) or None
+        host_phases = (
+            {
+                "prep_s": round(sum(phases.values()), 6),
+                **{f"prep_{k}_s": round(v, 6) for k, v in phases.items()},
+            }
+            if phases
+            else None
+        )
         result = SieveResult(
             n=cfg.n,
             pi=pi,
@@ -142,6 +155,7 @@ class Coordinator:
             elapsed_s=elapsed,
             values_per_sec=(cfg.n - 1) / elapsed if elapsed > 0 else float("inf"),
             segments=results,
+            host_phases=host_phases,
         )
         self.metrics.run_summary(result)
         return result

@@ -204,6 +204,170 @@ def prepare_pallas(
     )
 
 
+class PallasChain:
+    """Incremental ``prepare_pallas`` over a chain of segments sharing one
+    padded width.
+
+    Group membership (A/B/C/D/flat) depends only on the bit strides m, which
+    are segment-independent, so the grouped tables are built once at
+    construction; per segment only the residues advance — ``r' = (r - delta)
+    mod m`` via specs.DeltaModCache, no per-seed division — and the cheap
+    residue-dependent pieces are rebuilt: the rK column of each group, the
+    zero-crossing pruning of the (ND, 128) group-D table, the host-enumerated
+    flat crossings, self-mark corrections, and pair_mask. Output is identical
+    to from-scratch ``prepare_pallas(packing, lo, hi, seeds, wpad)``
+    (tests/test_prepare_stream.py), at a fraction of its host cost.
+
+    ``phase_seconds`` accumulates per-phase host time (residue / group /
+    flat / corrections) for tools/profile_prepare.py and the mesh metrics.
+    """
+
+    def __init__(self, packing: str, seeds: np.ndarray, wpad: int):
+        from sieve.kernels.specs import DeltaModCache, _tier1_strides
+
+        if wpad % TILE_WORDS:
+            raise ValueError(f"wpad {wpad} not a multiple of {TILE_WORDS}")
+        if 32 * wpad >= 1 << 30:
+            raise ValueError(f"wpad {wpad} too large for pallas kernel")
+        self.packing = packing
+        self.seeds = seeds
+        self.Wpad = wpad
+        self.layout = get_layout(packing)
+        self.phase_seconds = {
+            "residue": 0.0, "group": 0.0, "flat": 0.0, "corrections": 0.0,
+        }
+        self.segments_prepared = 0
+        m = _tier1_strides(packing, seeds, 1 << 62)
+        self._m = m
+        d_min = max(D_MIN, 4096)
+        f_min = _flat_cutoff(wpad)
+        ga = m < 32
+        gb = (m >= 32) & (m <= B_MAX)
+        gc = (m > B_MAX) & (m <= d_min)
+        self._gd = (m > d_min) & (m < f_min)
+        self._gf = m >= f_min
+        if np.count_nonzero(ga) > NA_PAD:
+            raise ValueError("group A overflow")
+        self._masks = (ga, gb, gc)
+        z = np.zeros
+        self._groups = tuple(
+            {
+                "arrs": _group_arrays(
+                    m[g], z(int(np.count_nonzero(g)), np.int64),
+                    wpad, pad, two_level=two,
+                ),
+                "Km": None,  # filled below: the segment-independent K*m term
+                "S": int(np.count_nonzero(g)),
+                "mask": g,
+            }
+            for g, pad, two in (
+                (ga, NA_PAD, True), (gb, 128, True), (gc, 128, False),
+            )
+        )
+        for g in self._groups:
+            # rK of the zero-residue base IS K*m for the real entries
+            g["Km"] = g["arrs"][1][0, : g["S"]].astype(np.int64)
+        md = m[self._gd]
+        self._d_m = md
+        self._d_Km = -(-32 * wpad // np.maximum(md, 1)) * md
+        self._d_rcp = (1.0 / md.astype(np.float64)).astype(np.float32)
+        self._f_m = m[self._gf]
+        self._dm = DeltaModCache(m)
+        self._r: np.ndarray | None = None
+        self._g0: int | None = None
+
+    @property
+    def SB(self) -> int:
+        """Padded group-B width — identical for every segment of the chain."""
+        return self._groups[1]["arrs"][0].shape[1]
+
+    @property
+    def SC(self) -> int:
+        """Padded group-C width — identical for every segment of the chain."""
+        return self._groups[2]["arrs"][0].shape[1]
+
+    def _residues(self, lo: int) -> np.ndarray:
+        g0 = self.layout.gidx(self.layout.first_candidate(lo))
+        if self._r is None:
+            m, r = tier1_specs(self.packing, lo, self.seeds, tier1_max=1 << 62)
+            assert m.shape == self._m.shape
+            self._r = r.astype(np.int64)
+        else:
+            self._r = self._dm.advance(self._r, g0 - self._g0)
+        self._g0 = g0
+        return self._r
+
+    def _with_residue(self, g: dict, r_g: np.ndarray) -> tuple[np.ndarray, ...]:
+        arrs = list(g["arrs"])
+        rK = arrs[1].copy()
+        if g["S"]:
+            rK[0, : g["S"]] = g["Km"] + r_g
+        arrs[1] = rK
+        return tuple(arrs)
+
+    def prepare(self, lo: int, hi: int) -> PallasSegment:
+        import time
+
+        layout = self.layout
+        nbits = layout.nbits(lo, hi)
+        W = -(-nbits // 32)
+        Wseg = -(-(W + 1) // TILE_WORDS) * TILE_WORDS
+        if self.Wpad < Wseg:
+            raise ValueError(f"wpad {self.Wpad} < segment's {Wseg} or unaligned")
+        t0 = time.perf_counter()
+        r = self._residues(lo)
+        t1 = time.perf_counter()
+        A, B, C = (
+            self._with_residue(g, r[g["mask"]]) for g in self._groups
+        )
+        r_d = r[self._gd]
+        sel = r_d < nbits  # zero-crossing pruning (see prepare_pallas)
+        S = int(np.count_nonzero(sel))
+        P = max(D_LANES, -(-S // D_LANES) * D_LANES)
+        out_m = np.full(P, 1 << 29, np.int32)
+        out_rK = np.zeros(P, np.int32)
+        rcp = np.full(P, np.float32(1.0 / (1 << 29)), np.float32)
+        act = np.zeros(P, np.uint32)
+        out_m[:S] = self._d_m[sel]
+        out_rK[:S] = self._d_Km[sel] + r_d[sel]
+        rcp[:S] = self._d_rcp[sel]
+        act[:S] = 0xFFFFFFFF
+        D = tuple(
+            a.reshape(-1, D_LANES) for a in (out_m, out_rK, rcp, act)
+        )
+        t2 = time.perf_counter()
+        fi, fm = flat_crossings(self._f_m, r[self._gf], nbits)
+        t3 = time.perf_counter()
+
+        from sieve.kernels.specs import _corrections
+
+        ci, cm = _corrections(self.packing, lo, hi, self.seeds, pad_to=32)
+        ci_pad = np.full(ci.size, -1, np.int32)
+        real = cm != 0
+        ci_pad[real] = ci[real].astype(np.int32)
+        pair_mask = _pair_mask(self.packing, lo)
+        t4 = time.perf_counter()
+        ph = self.phase_seconds
+        ph["residue"] += t1 - t0
+        ph["group"] += t2 - t1
+        ph["flat"] += t3 - t2
+        ph["corrections"] += t4 - t3
+        self.segments_prepared += 1
+        return PallasSegment(
+            nbits=nbits,
+            Wpad=self.Wpad,
+            A=A,
+            B=B,
+            C=C,
+            D=D,
+            corr_idx=ci_pad.reshape(1, -1),
+            corr_mask=cm.reshape(1, -1),
+            flat_idx=fi.reshape(1, -1),
+            flat_mask=fm.reshape(1, -1),
+            pair_mask=pair_mask,
+        )
+
+
 def spec_counts(ps: PallasSegment) -> dict:
     """Real (unpadded) per-tier spec counts of one prepared segment — for
     artifacts and logs (group D reports LIVE rows post-pruning; flat

@@ -100,6 +100,116 @@ def _w30_idx(res: np.ndarray) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Incremental residue advancement (streaming prepare pipeline).
+# ---------------------------------------------------------------------------
+
+
+class DeltaModCache:
+    """``delta % m`` over a fixed stride vector, cached per distinct delta.
+
+    Advancing a bit-space residue vector from one segment origin to the next
+    is ``r' = (r - delta) mod m``; once ``delta % m`` is known that is a
+    subtract plus one conditional add — no per-seed division.  Contiguous
+    equal-span segments share a handful of distinct deltas (plan_segments
+    aligns interior boundaries, so spans differ by at most the alignment),
+    so steady-state advancement costs O(1) vector ops per seed."""
+
+    def __init__(self, m: np.ndarray):
+        self.m = np.asarray(m, np.int64)
+        self._dm: dict[int, np.ndarray] = {}
+
+    def advance(self, r: np.ndarray, delta: int) -> np.ndarray:
+        if delta == 0:
+            return r
+        dm = self._dm.get(delta)
+        if dm is None:
+            if len(self._dm) >= 64:  # bound the cache on erratic jump chains
+                self._dm.clear()
+            dm = self._dm[delta] = delta % self.m  # in [0, m) even for delta<0
+        r = r - dm
+        return np.where(r < 0, r + self.m, r)
+
+
+class SpecChain:
+    """Incremental ``marking_specs`` over a chain of segments.
+
+    A seed prime's marking spec changes between segments only through the
+    segment origin bit g0 = gidx(first_candidate(lo)): the bit-space residue
+    class of a prime is a *global* arithmetic progression, so the local
+    residue advances as ``r' = (r - delta) mod m`` with delta = g0' - g0
+    (see DeltaModCache).  The start bound — max(p^2, lo), the classic nest of
+    SURVEY.md section 4.2 — is restored exactly from ``g_start``, the global
+    bit of each spec's first admissible multiple (>= p^2), which is
+    segment-independent and precomputed once.  The per-segment output is
+    bit-identical to from-scratch ``marking_specs`` (tests/test_prepare_stream
+    proves it across packings and boundary cases) while doing none of the
+    per-seed ``ceil(lo/p)`` divisions that made upfront prep O(seeds) worth
+    of latency per segment."""
+
+    def __init__(self, packing: str, seeds: np.ndarray):
+        self.packing = packing
+        self.layout = get_layout(packing)
+        p = seeds.astype(np.int64)
+        if packing == "plain":
+            self.m = p
+            self._g_start = p * p  # gidx(v) == v for plain
+        elif packing == "odds":
+            p = p[p > 2]
+            self.m = p
+            self._g_start = (p * p - 3) // 2  # gidx(p^2), p odd => p^2 odd
+        elif packing == "wheel30":
+            p = p[p > 5]
+            pinv = _W30_INV_ARR[p % 30]
+            res = np.array(WHEEL30_RESIDUES, dtype=np.int64)
+            c = (res[None, :] * pinv[:, None]) % 30
+            # first admissible multiple per (prime, class): m0 >= p, m0 == c
+            m0 = p[:, None] + (c - p[:, None]) % 30
+            v0 = p[:, None] * m0
+            gs = 8 * (v0 // 30) + _w30_idx(v0 % 30)
+            self.m = np.repeat(8 * p, 8)
+            self._g_start = gs.ravel()
+        else:
+            raise ValueError(f"unknown packing {packing!r}")
+        self._dm = DeltaModCache(self.m)
+        self._r: np.ndarray | None = None
+        self._g0: int | None = None
+
+    def residues(self, lo: int, hi: int) -> tuple[int, np.ndarray, np.ndarray]:
+        """(nbits, r, s) over the FULL chain spec set, unfiltered.
+
+        ``r`` is the segment-local residue of every spec; ``s`` its start bit
+        (first bit the from-scratch nest would mark).  A spec is live in this
+        segment iff ``s < nbits``."""
+        layout = self.layout
+        nbits = layout.nbits(lo, hi)
+        if nbits >= 2**31:
+            raise ValueError(f"segment too large: {nbits} bits >= 2^31")
+        g0 = layout.gidx(layout.first_candidate(lo))
+        if self._r is None:
+            self._r = (self._g_start - g0) % self.m  # one-time vectorized mod
+        else:
+            self._r = self._dm.advance(self._r, g0 - self._g0)
+        self._g0 = g0
+        s = np.where(self._g_start > g0, self._g_start - g0, self._r)
+        return nbits, self._r, s
+
+    def specs(self, lo: int, hi: int) -> SpecSet:
+        """Drop-in replacement for ``marking_specs(packing, lo, hi, seeds)``."""
+        nbits_probe = self.layout.nbits(lo, hi)
+        if nbits_probe == 0:
+            z = np.zeros(0, np.int32)
+            return SpecSet(z, z, z, 0)
+        nbits, r, s = self.residues(lo, hi)
+        keep = s < nbits
+        return SpecSet(
+            m=self.m[keep].astype(np.int32),
+            r=r[keep].astype(np.int32),
+            s=s[keep].astype(np.int32),
+            nbits=nbits,
+        )
+
+
+# ---------------------------------------------------------------------------
 # Tiered preparation for the word kernel (sieve/kernels/jax_mark.py).
 # ---------------------------------------------------------------------------
 
@@ -208,6 +318,21 @@ def _tier1_patterns(
     return periods, tuple(by_period[p] for p in periods)
 
 
+def _merge_word_masks(bits: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Merge non-negative bit indices into sorted per-word
+    (word_idx, OR-mask) pairs: one argsort + ``np.bitwise_or.reduceat``,
+    no python loop. Shared by ``_corrections`` and ``flat_crossings``."""
+    words = bits >> 5
+    masks = np.uint32(1) << (bits & 31).astype(np.uint32)
+    order = np.argsort(words, kind="stable")
+    ws, ms = words[order], masks[order]
+    new = np.empty(ws.size, bool)
+    new[0] = True
+    new[1:] = ws[1:] != ws[:-1]
+    grp = np.flatnonzero(new)
+    return ws[grp].astype(np.int32), np.bitwise_or.reduceat(ms, grp)
+
+
 def _corrections(
     packing: str, lo: int, hi: int, seeds: np.ndarray, pad_to: int = 32
 ) -> tuple[np.ndarray, np.ndarray]:
@@ -221,13 +346,7 @@ def _corrections(
     if p.size:
         g0 = layout.gidx(layout.first_candidate(lo))
         bits = layout.gidx_np(p) - g0
-        words = (bits // 32).astype(np.int64)
-        masks = np.uint32(1) << (bits % 32).astype(np.uint32)
-        uniq = np.unique(words)
-        merged = np.zeros(uniq.size, dtype=np.uint32)
-        for i, u in enumerate(uniq):
-            merged[i] = np.bitwise_or.reduce(masks[words == u])
-        idx, msk = uniq.astype(np.int32), merged
+        idx, msk = _merge_word_masks(bits)
     else:
         idx = np.zeros(0, np.int32)
         msk = np.zeros(0, np.uint32)
@@ -262,16 +381,7 @@ def flat_crossings(
         starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
         offs = np.arange(tot) - np.repeat(starts, counts)
         bits = r[spec] + offs * m[spec]
-        words = bits >> 5
-        masks = (np.uint32(1) << (bits & 31).astype(np.uint32))
-        order = np.argsort(words, kind="stable")
-        ws, ms = words[order], masks[order]
-        new = np.empty(tot, bool)
-        new[0] = True
-        new[1:] = ws[1:] != ws[:-1]
-        grp = np.flatnonzero(new)
-        idx = ws[grp].astype(np.int32)
-        msk = np.bitwise_or.reduceat(ms, grp)
+        idx, msk = _merge_word_masks(bits)
     else:
         idx = np.zeros(0, np.int32)
         msk = np.zeros(0, np.uint32)
@@ -348,5 +458,135 @@ def prepare_tiered(
         corr_mask=corr_mask,
         pair_mask=_pair_mask(packing, lo),
     )
+
+
+def _tier1_strides(packing: str, seeds: np.ndarray, tier1_max: int) -> np.ndarray:
+    """The stride column of ``tier1_specs`` — lo-independent."""
+    p = seeds.astype(np.int64)
+    if packing == "plain":
+        return p[p <= tier1_max]
+    if packing == "odds":
+        return p[(p > 2) & (p <= tier1_max)]
+    if packing == "wheel30":
+        p = p[(p > 5) & (8 * p <= tier1_max)]
+        return np.repeat(8 * p, 8)
+    raise ValueError(f"unknown packing {packing!r}")
+
+
+class TieredChain:
+    """Incremental ``prepare_tiered`` over a chain of segments.
+
+    Stride-dependent structure is built once: the full marking-spec stride
+    vector and its tier-2 membership (segment-independent), per-spec f32
+    reciprocals, and the tier-1 stride set — hence ``periods`` is known
+    before any segment is prepared, so a mesh shard can build its compiled
+    kernel without a throwaway prepare. Per segment only the residues
+    advance (SpecChain / DeltaModCache) and the genuinely per-segment
+    pieces are rebuilt: tier-1 patterns, the K2 column for the segment's
+    Wpad (cached per distinct Wpad), self-mark corrections, pair_mask.
+    Output is identical to from-scratch ``prepare_tiered``."""
+
+    def __init__(
+        self,
+        packing: str,
+        seeds: np.ndarray,
+        tier1_max: int,
+        spec_block: int,
+        word_bucket: int,
+    ):
+        self.packing = packing
+        self.seeds = seeds
+        self.tier1_max = tier1_max
+        self.spec_block = spec_block
+        self.word_bucket = word_bucket
+        self.layout = get_layout(packing)
+        self._spec = SpecChain(packing, seeds)
+        self._big_idx = np.flatnonzero(self._spec.m > tier1_max)
+        m2_all = self._spec.m[self._big_idx]
+        self._m2_all = m2_all
+        self.n_tier2 = int(m2_all.size)  # upper bound on any segment's live set
+        self.phase_seconds = {"residue": 0.0, "group": 0.0, "corrections": 0.0}
+        self.segments_prepared = 0
+        self._rcp_all = (1.0 / m2_all).astype(np.float32)
+        self._t1_m = _tier1_strides(packing, seeds, tier1_max)
+        self.periods, _ = _tier1_patterns(
+            self._t1_m, np.zeros_like(self._t1_m)
+        )
+        self._t1_r: np.ndarray | None = None
+        self._t1_g0: int | None = None
+        self._t1_dm = DeltaModCache(self._t1_m)
+        self._K2_cache: dict[int, np.ndarray] = {}
+
+    def _tier1_residues(self, lo: int) -> np.ndarray:
+        g0 = self.layout.gidx(self.layout.first_candidate(lo))
+        if self._t1_r is None:
+            m1, self._t1_r = tier1_specs(
+                self.packing, lo, self.seeds, self.tier1_max
+            )
+            assert m1.shape == self._t1_m.shape
+        else:
+            self._t1_r = self._t1_dm.advance(self._t1_r, g0 - self._t1_g0)
+        self._t1_g0 = g0
+        return self._t1_r
+
+    def prepare(self, lo: int, hi: int) -> TieredSegment:
+        import time
+
+        t0 = time.perf_counter()
+        nbits, r_full, s_full = self._spec.residues(lo, hi)
+        W = -(-nbits // 32)
+        Wpad = -(-(W + 1) // self.word_bucket) * self.word_bucket
+        if Wpad > MAX_WORDS:
+            raise ValueError(
+                f"segment too large for word kernel: {nbits} bits "
+                f"(> {32 * MAX_WORDS}); use more segments/rounds"
+            )
+
+        r1 = self._tier1_residues(lo)
+        t1 = time.perf_counter()
+        periods, patterns = _tier1_patterns(self._t1_m, r1)
+
+        K_all = self._K2_cache.get(Wpad)
+        if K_all is None:
+            K_all = self._K2_cache[Wpad] = -(
+                -32 * Wpad // self._m2_all.astype(np.int64)
+            )
+        live = s_full[self._big_idx] < nbits
+        m2 = self._m2_all[live]
+        S2 = int(m2.size)
+        S2p = max(self.spec_block, -(-S2 // self.spec_block) * self.spec_block)
+        pad = S2p - S2
+        K_pad = -(-32 * Wpad // _PAD_M)
+        m2 = np.concatenate([m2, np.full(pad, _PAD_M, np.int64)])
+        r2 = np.concatenate([r_full[self._big_idx][live], np.zeros(pad, np.int64)])
+        K2 = np.concatenate([K_all[live], np.full(pad, K_pad, np.int64)])
+        rcp2 = np.concatenate(
+            [self._rcp_all[live], np.full(pad, 1.0 / _PAD_M, np.float32)]
+        )
+        act2 = np.concatenate(
+            [np.full(S2, 0xFFFFFFFF, np.uint32), np.zeros(pad, np.uint32)]
+        )
+        t2 = time.perf_counter()
+
+        corr_idx, corr_mask = _corrections(self.packing, lo, hi, self.seeds)
+        ph = self.phase_seconds
+        ph["residue"] += t1 - t0
+        ph["group"] += t2 - t1
+        ph["corrections"] += time.perf_counter() - t2
+        self.segments_prepared += 1
+        return TieredSegment(
+            nbits=nbits,
+            Wpad=Wpad,
+            periods=periods,
+            patterns=patterns,
+            m2=m2.astype(np.int32),
+            r2=r2.astype(np.int32),
+            K2=K2.astype(np.int32),
+            rcp2=rcp2,
+            act2=act2,
+            corr_idx=corr_idx,
+            corr_mask=corr_mask,
+            pair_mask=_pair_mask(self.packing, lo),
+        )
 
 

@@ -49,18 +49,28 @@ class MetricsLogger:
 
     def run_summary(self, result: "SieveResult") -> None:
         chips = max(1, self.config.workers)
-        self._emit(
-            {
-                "event": "run",
-                "n": result.n,
-                "pi": result.pi,
-                "twins": result.twin_pairs,
-                "backend": result.backend,
-                "packing": result.packing,
-                "elapsed_s": round(result.elapsed_s, 4),
-                "values_per_sec": round(result.values_per_sec, 1),
-                "primes_per_sec_per_chip": round(result.pi / result.elapsed_s / chips, 1)
-                if result.elapsed_s > 0
-                else None,
-            }
-        )
+        record = {
+            "event": "run",
+            "n": result.n,
+            "pi": result.pi,
+            "twins": result.twin_pairs,
+            "backend": result.backend,
+            "packing": result.packing,
+            "elapsed_s": round(result.elapsed_s, 4),
+            "values_per_sec": round(result.values_per_sec, 1),
+            "primes_per_sec_per_chip": round(result.pi / result.elapsed_s / chips, 1)
+            if result.elapsed_s > 0
+            else None,
+        }
+        phases = getattr(result, "host_phases", None)
+        if phases:
+            # host-prepare pipeline health alongside the headline rate
+            for key in (
+                "prep_s",
+                "prep_values_per_sec",
+                "device_idle_frac",
+                "overlap_efficiency",
+            ):
+                if key in phases:
+                    record[key] = phases[key]
+        self._emit(record)

@@ -43,8 +43,9 @@ from sieve.kernels.jax_mark import (
     mark_words_impl,
     next_pow2,
 )
-from sieve.kernels.specs import prepare_tiered
+from sieve.kernels.specs import TieredChain
 from sieve.metrics import MetricsLogger
+from sieve.parallel.pipeline import PrepPipeline
 from sieve.seed import seed_primes
 from sieve.segments import plan_segments, validate_plan
 from sieve.worker import SegmentResult
@@ -310,45 +311,58 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
 
     seeds = seed_primes(cfg.seed_limit)
     twin_kind = TWIN_KIND[cfg.packing] if cfg.twins else TWIN_NONE
+    # Shared shapes are derived from the segment plan and the chain's
+    # segment-independent structure — no upfront prepare of any segment.
+    # Corrections-word bound: one word per seed prime in range at most.
+    seg_lo = np.array([s.lo for s in segs], np.int64)
+    seg_hi = np.array([s.hi for s in segs], np.int64)
+    seed_cnt = np.searchsorted(seeds, seg_hi) - np.searchsorted(seeds, seg_lo)
+    CC = int(max(32, -(-int(seed_cnt.max()) // 32) * 32))
     if use_pallas:
         # Wpad/SB/SC/CC are shared across ALL shards and rounds (Wpad is
         # baked into every spec's rK offset, so it must be fixed before
-        # grouping; B/C/correction counts barely vary). ND and FC are
-        # padded per ROUND instead: live group-D rows (post-pruning) and
-        # flat crossing lists shrink as rounds move to windows the wide
+        # grouping; B/C membership depends only on the strides, so every
+        # segment gets the same padded widths). ND and FC are padded per
+        # ROUND instead: live group-D rows (post-pruning) and flat
+        # crossing lists shrink as rounds move to windows the wide
         # strides barely cross, and padding them to the global max would
         # re-add exactly the sweep cost the pruner removed. The per-round
         # step is lru_cached by its (ND, FC) bucket.
         from sieve.kernels.pallas_mark import (
             TILE_WORDS,
+            PallasChain,
             pad_pallas,
-            prepare_pallas,
         )
 
         Wmax = max(-(-layout.nbits(s.lo, s.hi) // 32) for s in segs)
         Wpad = -(-(Wmax + 1) // TILE_WORDS) * TILE_WORDS
-        prep0 = [
-            prepare_pallas(cfg.packing, s.lo, s.hi, seeds, wpad=Wpad)
-            for s in segs
-        ]
-        SB = max(p.B[0].shape[1] for p in prep0)
-        SC = max(p.C[0].shape[1] for p in prep0)
-        CC = max(p.corr_idx.shape[1] for p in prep0)
+        template = PallasChain(cfg.packing, seeds, Wpad)
+        SB = template.SB
+        SC = template.SC
         interpret = mesh.devices.flat[0].platform == "cpu"
         step = None  # built per round (shape-bucketed) in the loop below
+
+        def _make_chain():
+            return PallasChain(cfg.packing, seeds, Wpad)
     else:
-        prep0 = [
-            prepare_tiered(cfg.packing, s.lo, s.hi, seeds,
-                           tier1_max=TIER1_MAX, spec_block=SPEC_BLOCK,
-                           word_bucket=WORD_BUCKET)
-            for s in segs
-        ]
-        Wpad = max(p.Wpad for p in prep0)
-        S2 = max(SPEC_BLOCK, next_pow2(max(p.m2.size for p in prep0)))
-        C = max(p.corr_idx.size for p in prep0)
-        periods = prep0[0].periods
-        assert all(p.periods == periods for p in prep0), "tier-1 periods diverged"
+        Wseg = [-(-layout.nbits(s.lo, s.hi) // 32) for s in segs]
+        Wpad = max(
+            -(-(W + 1) // WORD_BUCKET) * WORD_BUCKET for W in Wseg
+        )
+        template = TieredChain(cfg.packing, seeds, TIER1_MAX, SPEC_BLOCK,
+                               WORD_BUCKET)
+        periods = template.periods
+        # every segment's live tier-2 set is a subset of the chain's
+        # tier-2 specs; padding to the (pow2-bucketed) full count is inert
+        S2 = next_pow2(
+            max(SPEC_BLOCK, -(-template.n_tier2 // SPEC_BLOCK) * SPEC_BLOCK)
+        )
+        C = CC
         step = _make_step(mesh_key, Wpad, twin_kind, periods, ndev)
+
+        def _make_chain():
+            return TieredChain(cfg.packing, seeds, TIER1_MAX, SPEC_BLOCK,
+                               WORD_BUCKET)
 
     def _pad1(a, n, fill=0):
         if a.size == n:
@@ -437,85 +451,157 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                     f"ppermute twin path diverged: {total_twins} != {expect}"
                 )
 
-    for rnd in range(max(1, cfg.rounds)):
-        batch = segs[rnd * ndev : (rnd + 1) * ndev]
-        if all(s.seg_id in done for s in batch):
-            continue
-        rt0 = time.perf_counter()
-        preps = [prep0[s.seg_id] for s in batch]
-        nbits_v = np.array([p.nbits for p in preps], np.int32)
-        # gap_ok[d] = 1 iff (last candidate of seg d, first of seg d+1) is a
-        # potential twin pair (values differ by 2) — odds on-device straddle
-        gap_ok = np.zeros(ndev, np.int32)
-        if cfg.packing == "odds" and cfg.twins:
-            for i in range(len(batch) - 1):
-                lv = layout.last_candidate(batch[i].hi)
-                fv = layout.first_candidate(batch[i + 1].lo)
-                if fv - lv == 2 and fv <= cfg.n:
-                    gap_ok[i] = 1
-        if use_pallas:
-            # round-max shared shapes (bucketed -> bounded recompiles)
-            nd = max((p.D[0].shape[0] if p.D[3].any() else 0) for p in preps)
-            ND_r = -(-nd // ND_BUCKET) * ND_BUCKET
-            FC_r = max(p.flat_idx.shape[1] for p in preps)
-            preps = [
-                pad_pallas(p, SB, SC, max(ND_r, 1), CC, FC_r) for p in preps
-            ]
-            rstep = _make_pallas_step(
-                mesh_key, Wpad, twin_kind, SB, SC, ND_r, CC, FC_r, ndev,
-                interpret,
-            )
-            if multihost:
-                rstep = (lambda *a, _r=rstep: _r(*_globalize(mesh, a)))
-            groups = [
-                np.stack([p.A[i] for p in preps]) for i in range(6)
-            ] + [
-                np.stack([p.B[i] for p in preps]) for i in range(6)
-            ] + [
-                np.stack([p.C[i] for p in preps]) for i in range(4)
-            ] + [
-                np.stack([p.D[i] for p in preps]) for i in range(4)
-            ]
-            out = rstep(
-                nbits_v.reshape(-1, 1, 1),
-                np.array(
-                    [p.pair_mask for p in preps], np.uint32
-                ).reshape(-1, 1, 1),
-                *groups,
-                np.stack([p.corr_idx for p in preps]),
-                np.stack([p.corr_mask for p in preps]),
-                np.stack([p.flat_idx for p in preps]),
-                np.stack([p.flat_mask for p in preps]),
-                gap_ok,
-            )
-        else:
-            patterns = tuple(
-                np.stack([p.patterns[i] for p in preps])
-                for i in range(len(periods))
-            )
-            m2 = np.stack([_pad1(p.m2, S2, 1 << 20) for p in preps])
-            r2 = np.stack([_pad1(p.r2, S2) for p in preps])
-            K2 = np.stack([_pad1(p.K2, S2, 1) for p in preps])
-            rcp2 = np.stack(
-                [_pad1(p.rcp2, S2, np.float32(2.0 ** -20)) for p in preps]
-            )
-            act2 = np.stack([_pad1(p.act2, S2) for p in preps])
-            ci = np.stack([_pad1(p.corr_idx, C) for p in preps])
-            cm = np.stack([_pad1(p.corr_mask, C) for p in preps])
-            pmask = np.array([p.pair_mask for p in preps], np.uint32)
-            out = step(
-                nbits_v, patterns, m2, r2, K2, rcp2, act2, ci, cm, pmask, gap_ok
-            )
-        pending.append((batch, nbits_v, out, rt0))
-        while len(pending) > window:
-            _drain_one()
+    # Streaming prepare (the tentpole): only rounds NOT already restored
+    # from the ledger enter the pipeline — a resume prepares nothing for
+    # completed rounds — and at most window+1 rounds of preps are ever
+    # resident while background threads prepare round k+window during
+    # round k's device compute.
+    todo = [
+        rnd
+        for rnd in range(max(1, cfg.rounds))
+        if not all(
+            s.seg_id in done for s in segs[rnd * ndev : (rnd + 1) * ndev]
+        )
+    ]
+    pipeline = PrepPipeline(
+        todo,
+        _make_chain,
+        lambda chain, rnd: [
+            chain.prepare(s.lo, s.hi)
+            for s in segs[rnd * ndev : (rnd + 1) * ndev]
+        ],
+        window,
+    )
+    host_t = {"prep_wait_s": 0.0, "stack_s": 0.0, "device_idle_s": 0.0}
 
-    while pending:
-        _drain_one()
+    try:
+        for rnd in todo:
+            batch = segs[rnd * ndev : (rnd + 1) * ndev]
+            rt0 = time.perf_counter()
+            # nothing dispatched and undrained -> the device sits idle for
+            # exactly the host time until the next dispatch below
+            device_starved = not pending
+            preps = pipeline.take(rnd)
+            t_prep = time.perf_counter()
+            host_t["prep_wait_s"] += t_prep - rt0
+            nbits_v = np.array([p.nbits for p in preps], np.int32)
+            # gap_ok[d] = 1 iff (last candidate of seg d, first of seg d+1)
+            # is a potential twin pair (values differ by 2) — odds
+            # on-device straddle
+            gap_ok = np.zeros(ndev, np.int32)
+            if cfg.packing == "odds" and cfg.twins:
+                for i in range(len(batch) - 1):
+                    lv = layout.last_candidate(batch[i].hi)
+                    fv = layout.first_candidate(batch[i + 1].lo)
+                    if fv - lv == 2 and fv <= cfg.n:
+                        gap_ok[i] = 1
+            if use_pallas:
+                # round-max shared shapes (bucketed -> bounded recompiles)
+                nd = max(
+                    (p.D[0].shape[0] if p.D[3].any() else 0) for p in preps
+                )
+                ND_r = -(-nd // ND_BUCKET) * ND_BUCKET
+                FC_r = max(p.flat_idx.shape[1] for p in preps)
+                preps = [
+                    pad_pallas(p, SB, SC, max(ND_r, 1), CC, FC_r)
+                    for p in preps
+                ]
+                rstep = _make_pallas_step(
+                    mesh_key, Wpad, twin_kind, SB, SC, ND_r, CC, FC_r, ndev,
+                    interpret,
+                )
+                if multihost:
+                    rstep = (lambda *a, _r=rstep: _r(*_globalize(mesh, a)))
+                groups = [
+                    np.stack([p.A[i] for p in preps]) for i in range(6)
+                ] + [
+                    np.stack([p.B[i] for p in preps]) for i in range(6)
+                ] + [
+                    np.stack([p.C[i] for p in preps]) for i in range(4)
+                ] + [
+                    np.stack([p.D[i] for p in preps]) for i in range(4)
+                ]
+                args = (
+                    nbits_v.reshape(-1, 1, 1),
+                    np.array(
+                        [p.pair_mask for p in preps], np.uint32
+                    ).reshape(-1, 1, 1),
+                    *groups,
+                    np.stack([p.corr_idx for p in preps]),
+                    np.stack([p.corr_mask for p in preps]),
+                    np.stack([p.flat_idx for p in preps]),
+                    np.stack([p.flat_mask for p in preps]),
+                    gap_ok,
+                )
+                t_stack = time.perf_counter()
+                out = rstep(*args)
+            else:
+                patterns = tuple(
+                    np.stack([p.patterns[i] for p in preps])
+                    for i in range(len(periods))
+                )
+                m2 = np.stack([_pad1(p.m2, S2, 1 << 20) for p in preps])
+                r2 = np.stack([_pad1(p.r2, S2) for p in preps])
+                K2 = np.stack([_pad1(p.K2, S2, 1) for p in preps])
+                rcp2 = np.stack(
+                    [_pad1(p.rcp2, S2, np.float32(2.0 ** -20)) for p in preps]
+                )
+                act2 = np.stack([_pad1(p.act2, S2) for p in preps])
+                ci = np.stack([_pad1(p.corr_idx, C) for p in preps])
+                cm = np.stack([_pad1(p.corr_mask, C) for p in preps])
+                pmask = np.array([p.pair_mask for p in preps], np.uint32)
+                args = (
+                    nbits_v, patterns, m2, r2, K2, rcp2, act2, ci, cm,
+                    pmask, gap_ok,
+                )
+                t_stack = time.perf_counter()
+                out = step(*args)
+            host_t["stack_s"] += t_stack - t_prep
+            if device_starved:
+                # prep-wait + stacking with an empty device queue is true
+                # device idle; the dispatch call itself (which includes
+                # trace/compile on first use of a shape bucket) is not
+                # counted — compile cost is amortized and not a
+                # prepare-pipeline property
+                host_t["device_idle_s"] += t_stack - rt0
+            pending.append((batch, nbits_v, out, rt0))
+            while len(pending) > window:
+                _drain_one()
+
+        while pending:
+            _drain_one()
+    finally:
+        pipeline.close()
 
     results = [done[s.seg_id] for s in segs]
     pi, twin_pairs = merge_results(cfg, results)
     elapsed = time.perf_counter() - t0
+
+    chain_phases: dict[str, float] = {}
+    for st in pipeline.states:
+        for k, v in getattr(st, "phase_seconds", {}).items():
+            chain_phases[k] = chain_phases.get(k, 0.0) + v
+    prep_s = pipeline.stats["prep_seconds"]
+    values_prepared = sum(
+        s.hi - s.lo for rnd in todo for s in segs[rnd * ndev : (rnd + 1) * ndev]
+    )
+    idle_frac = host_t["device_idle_s"] / elapsed if elapsed > 0 else 0.0
+    host_phases = {
+        "prep_s": round(prep_s, 6),
+        "prep_wait_s": round(host_t["prep_wait_s"], 6),
+        "stack_s": round(host_t["stack_s"], 6),
+        "device_idle_s": round(host_t["device_idle_s"], 6),
+        "device_idle_frac": round(idle_frac, 6),
+        "overlap_efficiency": round(1.0 - idle_frac, 6),
+        "rounds_prepared": pipeline.stats["rounds_prepared"],
+        "peak_resident_rounds": pipeline.stats["peak_resident"],
+        "prep_values_per_sec": (
+            round(values_prepared / prep_s, 1) if prep_s > 0 else None
+        ),
+        **{f"prep_{k}_s": round(v, 6) for k, v in chain_phases.items()},
+    }
+    metrics.event("host_prepare", **host_phases)
+
     result = SieveResult(
         n=cfg.n,
         pi=pi,
@@ -526,6 +612,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
         elapsed_s=elapsed,
         values_per_sec=(cfg.n - 1) / elapsed if elapsed > 0 else float("inf"),
         segments=results,
+        host_phases=host_phases,
     )
     metrics.run_summary(result)
     return result

@@ -61,6 +61,10 @@ class SieveWorker(abc.ABC):
 
     def __init__(self, config: "SieveConfig"):
         self.config = config
+        # host-prepare phase totals (seconds), populated by backends that
+        # prepare incrementally (see sieve/kernels/specs.py chains); the
+        # coordinator surfaces them in SieveResult.host_phases
+        self.phase_seconds: dict[str, float] = {}
 
     @abc.abstractmethod
     def process_segment(

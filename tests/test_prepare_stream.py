@@ -1,0 +1,241 @@
+"""Streaming incremental host-prepare tests: chain-vs-from-scratch parity
+across packings and boundary cases, the PrepPipeline producer/consumer, and
+the mesh integration (resume skips prepare; kill-mid-run resume is exact;
+residency stays bounded)."""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sieve.checkpoint import Ledger
+from sieve.config import SieveConfig
+from sieve.kernels.jax_mark import SPEC_BLOCK, TIER1_MAX, WORD_BUCKET
+from sieve.kernels.specs import (
+    SpecChain,
+    TieredChain,
+    marking_specs,
+    prepare_tiered,
+)
+from sieve.parallel.pipeline import PrepPipeline
+from sieve.seed import seed_primes
+from tests.oracles import PI, TWINS
+
+PACKINGS = ["plain", "odds", "wheel30"]
+
+
+def _n_devices():
+    import jax
+
+    try:
+        return len(jax.devices("cpu"))
+    except RuntimeError:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# chain parity: incremental residue advance == from-scratch, bit for bit
+# ---------------------------------------------------------------------------
+
+# Boundary cases: lo crossing p^2 of small seeds (47->49=7^2, 121=11^2,
+# 361=19^2), word- and wheel-unaligned cuts, a sub-word sliver, and
+# arbitrary forward/backward jumps (the chain's advance is Delta-based, so
+# skipped or revisited windows must stay exact).
+_CUTS = [2, 47, 49, 121, 128, 360, 361, 1000, 1024, 2310, 5000, 10_007,
+         20_000]
+_SEGMENTS = list(zip(_CUTS, _CUTS[1:])) + [
+    (50_000, 50_003),     # sliver: 0-2 candidate bits depending on packing
+    (50_003, 80_000),
+    (30_000, 40_000),     # backward jump
+    (80_000, 80_000 + 7 * 32 * 3 + 5),  # unaligned span after a re-jump
+]
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+def test_spec_chain_matches_from_scratch(packing):
+    seeds = seed_primes(300)
+    chain = SpecChain(packing, seeds)
+    for lo, hi in _SEGMENTS:
+        got = chain.specs(lo, hi)
+        want = marking_specs(packing, lo, hi, seeds)
+        assert got.nbits == want.nbits, (lo, hi)
+        for f in ("m", "r", "s"):
+            a, b = getattr(got, f), getattr(want, f)
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(a, b, err_msg=f"{f} at {(lo, hi)}")
+
+
+def _assert_segment_equal(got, want, ctx):
+    for f in dataclasses.fields(want):
+        a, b = getattr(got, f.name), getattr(want, f.name)
+        if isinstance(b, tuple):
+            assert len(a) == len(b), (f.name, ctx)
+            for i, (x, y) in enumerate(zip(a, b)):
+                assert x.dtype == y.dtype, (f.name, i, ctx)
+                np.testing.assert_array_equal(
+                    x, y, err_msg=f"{f.name}[{i}] at {ctx}"
+                )
+        elif isinstance(b, np.ndarray):
+            assert a.dtype == b.dtype, (f.name, ctx)
+            np.testing.assert_array_equal(a, b, err_msg=f"{f.name} at {ctx}")
+        else:
+            assert a == b, (f.name, ctx)
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+def test_tiered_chain_matches_from_scratch(packing):
+    seeds = seed_primes(1000)
+    chain = TieredChain(packing, seeds, TIER1_MAX, SPEC_BLOCK, WORD_BUCKET)
+    for lo, hi in [(2, 10_000), (10_000, 30_000), (30_000, 30_517),
+                   (50_000, 90_000), (40_000, 50_000)]:
+        got = chain.prepare(lo, hi)
+        want = prepare_tiered(
+            packing, lo, hi, seeds,
+            tier1_max=TIER1_MAX, spec_block=SPEC_BLOCK,
+            word_bucket=WORD_BUCKET,
+        )
+        _assert_segment_equal(got, want, (packing, lo, hi))
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+def test_pallas_chain_matches_from_scratch(packing):
+    from sieve.bitset import get_layout
+    from sieve.kernels.pallas_mark import (
+        TILE_WORDS,
+        PallasChain,
+        prepare_pallas,
+    )
+
+    seeds = seed_primes(3000)  # strides past 4096 bits -> group D populated
+    layout = get_layout(packing)
+    bounds = [(2, 200_000), (200_000, 400_000), (600_000, 800_123),
+              (400_000, 600_000)]
+    W = max(-(-layout.nbits(lo, hi) // 32) for lo, hi in bounds)
+    wpad = -(-(W + 1) // TILE_WORDS) * TILE_WORDS
+    chain = PallasChain(packing, seeds, wpad)
+    for lo, hi in bounds:
+        got = chain.prepare(lo, hi)
+        want = prepare_pallas(packing, lo, hi, seeds, wpad=wpad)
+        _assert_segment_equal(got, want, (packing, lo, hi))
+
+
+# ---------------------------------------------------------------------------
+# PrepPipeline unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_prep_pipeline_orders_and_bounds_residency():
+    rounds = list(range(12))
+    done: list[int] = []
+    lock = threading.Lock()
+
+    def prep(state, rnd):
+        time.sleep(0.002)
+        with lock:
+            done.append(rnd)
+        return rnd * 10
+
+    pipe = PrepPipeline(rounds, list, prep, window=2, threads=2)
+    try:
+        for rnd in rounds:
+            assert pipe.take(rnd) == rnd * 10
+    finally:
+        pipe.close()
+    assert pipe.stats["rounds_prepared"] == 12
+    assert 1 <= pipe.stats["peak_resident"] <= 3  # window + 1
+    # claimed strictly in order even across two threads
+    assert sorted(done) == rounds
+
+
+def test_prep_pipeline_propagates_worker_errors():
+    def prep(state, rnd):
+        if rnd == 3:
+            raise ValueError("boom")
+        return rnd
+
+    pipe = PrepPipeline(list(range(6)), list, prep, window=1, threads=2)
+    try:
+        with pytest.raises(ValueError, match="boom"):
+            for rnd in range(6):
+                pipe.take(rnd)
+    finally:
+        pipe.close()
+
+
+# ---------------------------------------------------------------------------
+# mesh integration (needs the 8-device virtual CPU mesh from conftest)
+# ---------------------------------------------------------------------------
+
+needs_mesh = pytest.mark.skipif(
+    _n_devices() < 8, reason="needs the 8-device virtual CPU mesh"
+)
+
+
+@needs_mesh
+def test_mesh_resume_skips_prepare(tmp_path):
+    from sieve.parallel.mesh import run_mesh
+
+    cfg = SieveConfig(
+        n=10**5, workers=4, rounds=3, backend="jax", twins=True, quiet=True,
+        checkpoint_dir=str(tmp_path),
+    )
+    res1 = run_mesh(cfg)
+    assert res1.pi == PI[10**5]
+    assert res1.host_phases["rounds_prepared"] == 3
+    # full resume: every round restored from the ledger -> nothing prepared
+    cfg2 = SieveConfig(**{**cfg.to_dict(), "resume": True})
+    res2 = run_mesh(cfg2)
+    assert res2.pi == PI[10**5]
+    assert res2.twin_pairs == TWINS[10**5]
+    assert res2.host_phases["rounds_prepared"] == 0
+
+
+@needs_mesh
+@pytest.mark.parametrize("packing", ["odds", "wheel30"])
+def test_mesh_kill_midrun_resume_exact(tmp_path, monkeypatch, packing):
+    from sieve.parallel.mesh import run_mesh
+
+    monkeypatch.setenv("SIEVE_ROUND_WINDOW", "1")
+    cfg = SieveConfig(
+        n=10**5, workers=4, rounds=4, backend="jax", twins=True, quiet=True,
+        checkpoint_dir=str(tmp_path / packing), packing=packing,
+    )
+    real_record = Ledger.record
+    calls = {"n": 0}
+
+    def dying_record(self, res):
+        calls["n"] += 1
+        if calls["n"] > 6:  # dies mid round 1 (segments record per drain)
+            raise RuntimeError("simulated mid-run death")
+        return real_record(self, res)
+
+    monkeypatch.setattr(Ledger, "record", dying_record)
+    with pytest.raises(RuntimeError, match="simulated"):
+        run_mesh(cfg)
+    monkeypatch.setattr(Ledger, "record", real_record)
+
+    cfg2 = SieveConfig(**{**cfg.to_dict(), "resume": True})
+    res = run_mesh(cfg2)
+    assert res.pi == PI[10**5]
+    assert res.twin_pairs == TWINS[10**5]
+    # round 0 was fully recorded before the death -> resumed run prepared
+    # strictly fewer rounds than the plan, but at least the killed ones
+    assert 0 < res.host_phases["rounds_prepared"] < 4
+
+
+@needs_mesh
+def test_mesh_peak_resident_bounded(monkeypatch):
+    from sieve.parallel.mesh import run_mesh
+
+    monkeypatch.setenv("SIEVE_ROUND_WINDOW", "1")
+    cfg = SieveConfig(
+        n=10**5, workers=2, rounds=8, backend="jax", twins=True, quiet=True
+    )
+    res = run_mesh(cfg)
+    assert res.pi == PI[10**5]
+    assert res.twin_pairs == TWINS[10**5]
+    ph = res.host_phases
+    assert ph["rounds_prepared"] == 8
+    assert ph["peak_resident_rounds"] <= 2  # window + 1

@@ -47,6 +47,23 @@ def main():
 
     dt, ps = t(lambda: prepare_pallas("odds", lo, hi, seeds))
     print(f"prepare_pallas (host):      {dt*1e3:9.1f} ms")
+
+    # incremental chain prepare (what the streamed mesh/local paths pay per
+    # segment after init) with its per-phase split; re-preparing the same
+    # segment is a zero-delta advance, i.e. exactly the steady-state cost
+    from sieve.kernels.pallas_mark import PallasChain
+
+    chain = PallasChain("odds", seeds, ps.Wpad)
+    chain.prepare(lo, hi)  # init: one-time from-scratch residue derivation
+    base = dict(chain.phase_seconds)
+    reps = 3
+    dt, _ = t(lambda: chain.prepare(lo, hi), reps=reps)
+    phases = " ".join(
+        f"{k}={(v - base.get(k, 0.0)) / reps * 1e3:.1f}"
+        for k, v in chain.phase_seconds.items()
+    )
+    print(f"chain prepare (host, incr): {dt*1e3:9.1f} ms   "
+          f"avg phases ms: {phases}")
     SB = ps.B[0].shape[1]
     SC = ps.C[0].shape[1]
     ND = ps.D[0].shape[0] if ps.D[3].any() else 0
