@@ -1,7 +1,7 @@
 """Benchmark: the shallow AND depth regimes of the pallas sieve, plus the
-host-prepare pipeline.
+host-prepare pipeline and the fused-reduction bandwidth model.
 
-Prints THREE JSON lines {"metric", "value", "unit", "vs_baseline"}:
+Prints FOUR JSON lines {"metric", "value", "unit", "vs_baseline"}:
 
 1. pi(1e9), odds packing, tpu-pallas backend — the shallow regime.
    Baseline: BASELINE.md's measured CPU floor — pi(1e9) segmented numpy
@@ -19,6 +19,15 @@ Prints THREE JSON lines {"metric", "value", "unit", "vs_baseline"}:
    from-scratch prepare_pallas of the same segments. The line also
    carries overlap_efficiency / device_idle_frac measured from a real
    streamed mesh round loop. Host-only: emitted on any platform.
+4. Fused-reduction segment HBM traffic as a fraction of the split
+   (kernel + XLA postlude) path, from the byte-exact spec/bitset sizes
+   of a real prepared depth-regime-shaped segment: the split path
+   writes the packed bitset to HBM and re-reads every word in the
+   postlude (2 full bitset passes); the fused path ships only the
+   (1, 8) accumulator plus the per-tile cursor tables. Gated on a
+   bit-exact fused-vs-split parity check of that same segment.
+   vs_baseline = 0.55 / ratio, so >= 1 means the "one bitset pass
+   eliminated" target of ISSUE 3 is met. Host-only: emitted anywhere.
 
 Exact parity is asserted before any number is printed — the depth line
 against a cpu-numpy run of the same segment: a fast wrong sieve scores
@@ -199,10 +208,68 @@ def host_prepare_metric() -> None:
     )
 
 
+def fused_reduction_metric() -> None:
+    """Fused vs split reduction: parity gate + segment HBM traffic ratio.
+
+    Traffic is modeled from the actual prepared arrays of one segment
+    (spec streams are read by BOTH paths; only the bitset round trip
+    differs): split = specs + bitset write + bitset re-read; fused =
+    specs + per-tile cursors + the 32-byte accumulator. The parity gate
+    runs both kernels on the device (interpret mode off-TPU) and refuses
+    to print a number if they disagree — a fast wrong reduction scores
+    zero."""
+    import jax
+
+    from sieve.kernels.jax_mark import TWIN_ADJ
+    from sieve.kernels.pallas_mark import (
+        mark_pallas_fused,
+        mark_pallas_split,
+        prepare_pallas,
+    )
+    from sieve.seed import seed_primes
+
+    lo, hi = 2_000_003, 24_000_001
+    seeds = seed_primes(math.isqrt(hi - 1))
+    ps = prepare_pallas("odds", lo, hi, seeds)
+    interpret = jax.devices()[0].platform != "tpu"
+    fused = mark_pallas_fused(ps, TWIN_ADJ, interpret)
+    split = mark_pallas_split(ps, TWIN_ADJ, interpret)
+    assert fused == split, f"fused parity failure: {fused} != {split}"
+
+    spec_bytes = sum(
+        a.nbytes
+        for a in (
+            *ps.A, *ps.B, *ps.C, *ps.D,
+            ps.corr_idx, ps.corr_mask, ps.flat_idx, ps.flat_mask,
+        )
+    )
+    from sieve.kernels.pallas_mark import TILE_WORDS
+
+    bitset_bytes = ps.Wpad * 4
+    cursor_bytes = 2 * (ps.Wpad // TILE_WORDS + 1) * 4
+    split_bytes = spec_bytes + 2 * bitset_bytes
+    fused_bytes = spec_bytes + cursor_bytes + 32
+    ratio = fused_bytes / split_bytes
+    print(
+        json.dumps(
+            {
+                "metric": "fused_reduction_hbm_traffic_ratio",
+                "value": round(ratio, 4),
+                "unit": "fused/split segment bytes",
+                "vs_baseline": round(0.55 / ratio, 3),
+                "split_bytes": split_bytes,
+                "fused_bytes": fused_bytes,
+                "parity": list(fused),
+            }
+        )
+    )
+
+
 def main() -> int:
     shallow_metric()
     depth_metric()
     host_prepare_metric()
+    fused_reduction_metric()
     return 0
 
 

@@ -65,12 +65,12 @@ def _build_and_load() -> ctypes.CDLL:
     return lib
 
 
-def _pair_mask64(packing: str, lo: int) -> int:
-    """64-bit twin pairability mask: the 32-bit rule's period (8) divides
+def _pair_mask64(packing: str, lo: int, gap: int = 2) -> int:
+    """64-bit pairability mask: the 32-bit rule's period (8) divides
     32, so the wide mask is just the 32-bit helper doubled."""
     from sieve.kernels.specs import _pair_mask
 
-    m32 = _pair_mask(packing, lo)
+    m32 = _pair_mask(packing, lo, gap)
     return m32 | (m32 << 32)
 
 
@@ -122,11 +122,19 @@ class CpuNativeWorker(SieveWorker):
         count = int(lib.popcount_words(words_p, nwords)) + layout.extras_in(lo, hi)
         twin = 0
         if self.config.twins and nbits:
-            shift = 2 if packing == "plain" else 1
+            gap = getattr(self.config, "pair_gap", 2) or 2
+            if packing == "plain":
+                shift = gap
+            elif packing == "odds":
+                shift = gap // 2
+            else:
+                shift = 1
             twin = int(
-                lib.twin_count(words_p, nwords, shift, _pair_mask64(packing, lo))
+                lib.twin_count(
+                    words_p, nwords, shift, _pair_mask64(packing, lo, gap)
+                )
             )
-            twin += layout.extra_twin_pairs(lo, hi)
+            twin += layout.extra_pairs(lo, hi, gap)
         first_word, last_word = _boundary_words_u64(words, nbits)
         return SegmentResult(
             seg_id=seg_id,

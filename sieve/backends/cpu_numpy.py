@@ -44,8 +44,9 @@ class CpuNumpyWorker(SieveWorker):
         layout = get_layout(self.config.packing)
         flags = sieve_segment_flags(self.config.packing, lo, hi, seed_primes)
         count = int(np.count_nonzero(flags)) + layout.extras_in(lo, hi)
+        gap = getattr(self.config, "pair_gap", 2) or 2
         twin_count = (
-            layout.twins_internal(flags, lo, hi) if self.config.twins else 0
+            layout.pairs_internal(flags, lo, hi, gap) if self.config.twins else 0
         )
         first_word, last_word = boundary_words(flags)
         return SegmentResult(

@@ -21,6 +21,9 @@ import numpy as np
 from sieve.backends.cpu_numpy import CpuNumpyWorker
 from sieve.bitset import get_layout
 from sieve.kernels.jax_mark import (
+    COUSIN_ADJ,
+    COUSIN_PLAIN,
+    COUSIN_W30,
     SPEC_BLOCK,
     TIER1_MAX,
     TWIN_ADJ,
@@ -35,8 +38,19 @@ from sieve.kernels.specs import TieredChain, prepare_tiered
 from sieve.worker import SegmentResult, SieveWorker
 
 TWIN_KIND = {"plain": TWIN_PLAIN, "odds": TWIN_ADJ, "wheel30": TWIN_W30}
+COUSIN_KIND = {"plain": COUSIN_PLAIN, "odds": COUSIN_ADJ, "wheel30": COUSIN_W30}
 
 MIN_DEVICE_BITS = 64
+
+
+def pair_kind(config) -> int:
+    """Device pair-reduction kind for a config (--count-kind plug point):
+    TWIN_NONE when no pairs are counted, else the (packing, gap)-specific
+    splice kind the kernels run."""
+    gap = getattr(config, "pair_gap", 2 if config.twins else 0)
+    if gap == 0:
+        return TWIN_NONE
+    return (TWIN_KIND if gap == 2 else COUSIN_KIND)[config.packing]
 
 
 def prepare_segment(packing: str, lo: int, hi: int, seeds: np.ndarray):
@@ -79,6 +93,7 @@ class JaxWorker(SieveWorker):
                 packing, seeds,
                 tier1_max=TIER1_MAX, spec_block=SPEC_BLOCK,
                 word_bucket=WORD_BUCKET,
+                pair_gap=getattr(self.config, "pair_gap", 2) or 2,
             )
             self._chain_seeds = seeds
             self.phase_seconds = self._chain.phase_seconds
@@ -96,7 +111,7 @@ class JaxWorker(SieveWorker):
             return self._cpu_fallback.process_segment(lo, hi, seed_primes, seg_id)
 
         ts = self._prepare(packing, lo, hi, seed_primes)
-        twin_kind = TWIN_KIND[packing] if self.config.twins else TWIN_NONE
+        twin_kind = pair_kind(self.config)
         with self._placement():
             packed = np.asarray(mark_words(
                 ts.Wpad,
@@ -111,7 +126,8 @@ class JaxWorker(SieveWorker):
         count, twins, first32, last32 = (int(v) for v in packed)
         count += layout.extras_in(lo, hi)
         twin_count = (
-            twins + layout.extra_twin_pairs(lo, hi)
+            twins + layout.extra_pairs(
+                lo, hi, getattr(self.config, "pair_gap", 2) or 2)
             if self.config.twins
             else 0
         )

@@ -25,10 +25,14 @@ import time
 import numpy as np
 
 from sieve.backends.cpu_numpy import CpuNumpyWorker
-from sieve.backends.jax_backend import MIN_DEVICE_BITS, TWIN_KIND
+from sieve.backends.jax_backend import MIN_DEVICE_BITS, pair_kind
 from sieve.bitset import get_layout
-from sieve.kernels.jax_mark import TWIN_NONE
-from sieve.kernels.pallas_mark import TILE_WORDS, PallasChain, mark_pallas
+from sieve.kernels.pallas_mark import (
+    TILE_WORDS,
+    PallasChain,
+    mark_pallas,
+    pallas_fused_enabled,
+)
 from sieve.worker import SegmentResult, SieveWorker
 
 
@@ -46,6 +50,9 @@ class PallasWorker(SieveWorker):
         self._cpu_fallback = CpuNumpyWorker(config)
         self._chains: dict[int, PallasChain] = {}  # keyed by padded width
         self._chain_seeds: np.ndarray | None = None
+        # device mark+reduce time by reduction mode ("postlude_fused" /
+        # "postlude_split"); surfaced through SieveResult.host_phases
+        self.reduce_seconds: dict[str, float] = {}
 
     def _placement(self):
         if self._device is None:
@@ -64,7 +71,10 @@ class PallasWorker(SieveWorker):
         wpad = -(-(W + 1) // TILE_WORDS) * TILE_WORDS
         chain = self._chains.get(wpad)
         if chain is None:
-            chain = self._chains[wpad] = PallasChain(packing, seeds, wpad)
+            chain = self._chains[wpad] = PallasChain(
+                packing, seeds, wpad,
+                pair_gap=getattr(self.config, "pair_gap", 2) or 2,
+            )
         ps = chain.prepare(lo, hi)
         agg: dict[str, float] = {}
         for c in self._chains.values():
@@ -84,14 +94,24 @@ class PallasWorker(SieveWorker):
             return self._cpu_fallback.process_segment(lo, hi, seed_primes, seg_id)
 
         ps = self._prepare(packing, lo, hi, seed_primes)
-        twin_kind = TWIN_KIND[packing] if self.config.twins else TWIN_NONE
+        twin_kind = pair_kind(self.config)
+        self.reduction_mode = (
+            "fused" if pallas_fused_enabled() else "split"
+        )
+        t_dev = time.perf_counter()
         with self._placement():
             count, twins, first_word, last_word = mark_pallas(
                 ps, twin_kind, self._interpret
             )
+        key = "postlude_" + self.reduction_mode
+        self.reduce_seconds[key] = (
+            self.reduce_seconds.get(key, 0.0) + time.perf_counter() - t_dev
+        )
         count += layout.extras_in(lo, hi)
         twin_count = (
-            twins + layout.extra_twin_pairs(lo, hi) if self.config.twins else 0
+            twins + layout.extra_pairs(
+                lo, hi, getattr(self.config, "pair_gap", 2) or 2)
+            if self.config.twins else 0
         )
         return SegmentResult(
             seg_id=seg_id,

@@ -99,19 +99,24 @@ class Layout:
     def extras_in(self, lo: int, hi: int) -> int:
         return sum(1 for p in self.extra_primes if lo <= p < hi)
 
-    def extra_twin_pairs(self, lo: int, hi: int) -> int:
-        """Twin pairs invisible to this packing's flag array because a member
-        is a wheel prime (wheel30: (3,5) and (5,7)). Pairs counted when the
-        smaller member v satisfies lo <= v and v+2 < hi."""
+    def extra_pairs(self, lo: int, hi: int, gap: int = 2) -> int:
+        """Prime pairs (v, v+gap) invisible to this packing's flag array
+        because a member is a wheel prime (wheel30: (3,5)/(5,7) for twins,
+        (3,7) for cousins). Counted when lo <= v and v+gap < hi."""
         return 0
 
-    # --- twins -------------------------------------------------------------------
-    def twins_internal(self, flags: np.ndarray, lo: int, hi: int) -> int:
-        """Pairs (v, v+2) both prime with v, v+2 in [lo, hi).
+    def extra_twin_pairs(self, lo: int, hi: int) -> int:
+        return self.extra_pairs(lo, hi, 2)
 
-        Includes pairs involving extra primes (e.g. (3,5),(5,7) for wheel30).
-        """
+    # --- prime pairs -------------------------------------------------------------
+    def pairs_internal(self, flags: np.ndarray, lo: int, hi: int,
+                       gap: int = 2) -> int:
+        """Pairs (v, v+gap) both prime with v, v+gap in [lo, hi); gap is 2
+        (twins) or 4 (cousins). Includes pairs involving extra primes."""
         raise NotImplementedError
+
+    def twins_internal(self, flags: np.ndarray, lo: int, hi: int) -> int:
+        return self.pairs_internal(flags, lo, hi, 2)
 
 
 class PlainLayout(Layout):
@@ -146,11 +151,12 @@ class PlainLayout(Layout):
     def values_np(self, lo: int, bit_idx: np.ndarray) -> np.ndarray:
         return self.first_candidate(lo) + bit_idx.astype(np.int64)
 
-    def twins_internal(self, flags: np.ndarray, lo: int, hi: int) -> int:
-        if flags.size < 3:
+    def pairs_internal(self, flags: np.ndarray, lo: int, hi: int,
+                       gap: int = 2) -> int:
+        if flags.size <= gap:
             # fall back to direct check on tiny segments
-            return _twins_direct(self, flags, lo, hi)
-        return int(np.count_nonzero(flags[:-2] & flags[2:]))
+            return _pairs_direct(self, flags, lo, hi, gap)
+        return int(np.count_nonzero(flags[:-gap] & flags[gap:]))
 
 
 class OddsLayout(Layout):
@@ -193,10 +199,12 @@ class OddsLayout(Layout):
     def values_np(self, lo: int, bit_idx: np.ndarray) -> np.ndarray:
         return self.first_candidate(lo) + 2 * bit_idx.astype(np.int64)
 
-    def twins_internal(self, flags: np.ndarray, lo: int, hi: int) -> int:
-        if flags.size < 2:
+    def pairs_internal(self, flags: np.ndarray, lo: int, hi: int,
+                       gap: int = 2) -> int:
+        b = gap // 2  # value gap 2k == bit gap k in the odds layout
+        if flags.size < b + 1:
             return 0
-        return int(np.count_nonzero(flags[:-1] & flags[1:]))
+        return int(np.count_nonzero(flags[:-b] & flags[b:]))
 
 
 class Wheel30Layout(Layout):
@@ -242,35 +250,47 @@ class Wheel30Layout(Layout):
         g = self.gidx(self.first_candidate(lo)) + bit_idx.astype(np.int64)
         return 30 * (g // 8) + res[g % 8]
 
-    def twins_internal(self, flags: np.ndarray, lo: int, hi: int) -> int:
-        # Candidate pairs differing by 2 are exactly gidx-adjacent with the
-        # left member's residue index in {2 (11,13), 4 (17,19), 7 (29,31)}.
+    # residue indices whose gidx-NEXT candidate sits exactly `gap` above:
+    # gap=2 -> (11,13), (17,19), (29,31); gap=4 -> (7,11), (13,17), (19,23)
+    _PAIR_IDX = {2: (2, 4, 7), 4: (1, 3, 5)}
+
+    def pairs_internal(self, flags: np.ndarray, lo: int, hi: int,
+                       gap: int = 2) -> int:
+        # Candidate pairs differing by `gap` are exactly gidx-adjacent with
+        # the left member's residue index in _PAIR_IDX[gap].
+        idxset = self._PAIR_IDX[gap]
         total = 0
         if flags.size >= 2:
             first = self.first_candidate(lo)
             g0 = self.gidx(first)
             pos = np.arange(flags.size - 1, dtype=np.int64)
             resind = (g0 + pos) % 8
-            pairmask = (resind == 2) | (resind == 4) | (resind == 7)
+            pairmask = np.isin(resind, idxset)
             total += int(np.count_nonzero(flags[:-1] & flags[1:] & pairmask))
-        return total + self.extra_twin_pairs(lo, hi)
+        return total + self.extra_pairs(lo, hi, gap)
 
-    def extra_twin_pairs(self, lo: int, hi: int) -> int:
-        # Pairs involving wheel primes 3, 5 (always prime): (3,5) and (5,7).
+    def extra_pairs(self, lo: int, hi: int, gap: int = 2) -> int:
+        # Pairs involving the always-prime wheel primes 3, 5:
+        # twins (3,5), (5,7); cousins (3,7).
         total = 0
-        if lo <= 3 and 5 < hi:
-            total += 1
-        if lo <= 5 and 7 < hi:
-            total += 1
+        if gap == 2:
+            if lo <= 3 and 5 < hi:
+                total += 1
+            if lo <= 5 and 7 < hi:
+                total += 1
+        elif gap == 4:
+            if lo <= 3 and 7 < hi:
+                total += 1
         return total
 
 
-def _twins_direct(layout: Layout, flags: np.ndarray, lo: int, hi: int) -> int:
-    """O(candidates) direct twin count for tiny segments."""
+def _pairs_direct(layout: Layout, flags: np.ndarray, lo: int, hi: int,
+                  gap: int = 2) -> int:
+    """O(candidates) direct pair count for tiny segments."""
     vals = layout.candidates(lo, hi)
     primeset = {int(v) for v, f in zip(vals, flags[: vals.size]) if f}
     primeset |= {p for p in layout.extra_primes if lo <= p < hi}
-    return sum(1 for v in primeset if v + 2 in primeset)
+    return sum(1 for v in primeset if v + gap in primeset)
 
 
 LAYOUTS: dict[str, Layout] = {

@@ -44,6 +44,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="values per segment (alternative to --segments)")
     p.add_argument("--packing", choices=PACKINGS, default="odds")
     p.add_argument("--twins", action="store_true", help="also count twin-prime pairs")
+    p.add_argument("--count-kind", choices=("primes", "twins", "cousins"),
+                   default=None, dest="count_kind",
+                   help="pair reduction at the postlude: primes (count "
+                        "only), twins (p, p+2), cousins (p, p+4); same "
+                        "marking kernels either way (--twins is shorthand "
+                        "for --count-kind twins)")
     p.add_argument("--workers", type=int, default=1)
     p.add_argument("--multihost", action="store_true",
                    help="multi-host SPMD: jax.distributed.initialize() "
@@ -70,6 +76,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def config_from_args(args: argparse.Namespace) -> SieveConfig:
+    count_kind = getattr(args, "count_kind", None)
+    if count_kind is None:
+        count_kind = "twins" if args.twins else "primes"
+    elif args.twins and count_kind == "cousins":
+        raise ValueError("--twins conflicts with --count-kind cousins")
     return SieveConfig(
         n=args.n,
         multihost=args.multihost,
@@ -78,6 +89,7 @@ def config_from_args(args: argparse.Namespace) -> SieveConfig:
         n_segments=args.n_segments,
         segment_values=args.segment_values,
         twins=args.twins,
+        count_kind=count_kind,
         workers=args.workers,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
@@ -206,7 +218,10 @@ def _dispatch(args: argparse.Namespace, config: SieveConfig) -> int:
     else:
         print(f"pi({result.n}) = {result.pi}")
         if result.twin_pairs is not None:
-            print(f"twin pairs (p, p+2 <= {result.n}) = {result.twin_pairs}")
+            gap = config.pair_gap or 2
+            name = "cousin" if config.count_kind == "cousins" else "twin"
+            print(f"{name} pairs (p, p+{gap} <= {result.n}) = "
+                  f"{result.twin_pairs}")
         print(
             f"backend={result.backend} packing={result.packing} "
             f"segments={result.n_segments} elapsed={result.elapsed_s:.3f}s "

@@ -15,6 +15,12 @@ from typing import Any
 
 PACKINGS = ("plain", "odds", "wheel30")
 BACKENDS = ("cpu-numpy", "cpu-native", "cpu-cluster", "jax", "tpu-pallas")
+# --count-kind: which reduction runs on the marked bitset. All kinds share
+# the same marking specs/kernels — only the splice shift and pair mask at
+# the reduction differ (primes = count only; twins = p, p+2; cousins =
+# p, p+4). The gap between PAIR_GAPS entries is what the device splices.
+COUNT_KINDS = ("primes", "twins", "cousins")
+PAIR_GAPS = {"primes": 0, "twins": 2, "cousins": 4}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -33,6 +39,11 @@ class SieveConfig:
     n_segments: int | None = None
     segment_values: int | None = None
     twins: bool = False
+    # Pair-counting plug point: "primes" (count only), "twins" (p, p+2),
+    # "cousins" (p, p+4). ``twins=True`` is kept as the legacy spelling of
+    # count_kind="twins"; __post_init__ normalizes the two fields so either
+    # spelling yields the same config.
+    count_kind: str = "primes"
     # Workers / devices.
     workers: int = 1
     # Checkpoint / resume (SURVEY.md section 5.4).
@@ -66,6 +77,21 @@ class SieveConfig:
             raise ValueError("segment_values must be >= 4")
         if self.workers < 1:
             raise ValueError("workers must be >= 1")
+        if self.count_kind not in COUNT_KINDS:
+            raise ValueError(
+                f"count_kind must be one of {COUNT_KINDS}, got "
+                f"{self.count_kind!r}"
+            )
+        # normalize the two pair-counting spellings (frozen dataclass)
+        if self.count_kind == "primes" and self.twins:
+            object.__setattr__(self, "count_kind", "twins")
+        elif self.count_kind in ("twins", "cousins") and not self.twins:
+            object.__setattr__(self, "twins", True)
+
+    @property
+    def pair_gap(self) -> int:
+        """Prime-pair difference counted at the reduction (0 = none)."""
+        return PAIR_GAPS[self.count_kind]
 
     @property
     def seed_limit(self) -> int:
@@ -101,5 +127,9 @@ class SieveConfig:
             "segment_values": self.segment_values,
             "twins": self.twins,
         }
+        if self.count_kind == "cousins":
+            # key added only for the new kind so every pre-existing
+            # primes/twins ledger hash stays valid across the upgrade
+            payload["count_kind"] = self.count_kind
         blob = json.dumps(payload, sort_keys=True).encode()
         return hashlib.sha256(blob).hexdigest()[:16]

@@ -23,7 +23,7 @@ from sieve.config import SieveConfig
 from sieve.metrics import MetricsLogger
 from sieve.seed import seed_primes
 from sieve.segments import Segment, plan_segments, validate_plan
-from sieve.twins import straddle_twins
+from sieve.twins import straddle_pairs
 from sieve.worker import SegmentResult, SieveWorker
 
 
@@ -72,9 +72,10 @@ def merge_results(
     pi = sum(r.count for r in segs)
     twins: int | None = None
     if config.twins:
+        gap = getattr(config, "pair_gap", 2) or 2
         twins = sum(r.twin_count for r in segs)
         for a, b in zip(segs, segs[1:]):
-            twins += straddle_twins(layout, a, b, config.n)
+            twins += straddle_pairs(layout, a, b, config.n, gap)
     return pi, twins
 
 
@@ -145,6 +146,16 @@ class Coordinator:
             if phases
             else None
         )
+        mode = getattr(worker, "reduction_mode", None)
+        if mode is not None:
+            host_phases = dict(host_phases or {})
+            host_phases["reduction_mode"] = mode
+        reduce_s = getattr(worker, "reduce_seconds", None)
+        if reduce_s:
+            host_phases = dict(host_phases or {})
+            host_phases.update(
+                {f"{k}_s": round(v, 6) for k, v in reduce_s.items()}
+            )
         result = SieveResult(
             n=cfg.n,
             pi=pi,

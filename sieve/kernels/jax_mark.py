@@ -44,6 +44,19 @@ TWIN_NONE = 0
 TWIN_PLAIN = 1  # pairs (b, b+2): adjacent candidates differ by 1
 TWIN_ADJ = 2    # pairs (b, b+1): odds layout, adjacent candidates differ by 2
 TWIN_W30 = 3    # pairs (b, b+1) masked to residue indices {2, 4, 7}
+# --count-kind=cousins (p, p+4) reuses the same splice reduction with a
+# different shift/mask (wheel30: gidx-adjacent residue pairs (7,11),
+# (13,17), (19,23) -> left indices {1, 3, 5}; see specs._pair_mask):
+COUSIN_PLAIN = 4  # pairs (b, b+4)
+COUSIN_ADJ = 5    # pairs (b, b+2): odds layout, candidates differ by 4
+COUSIN_W30 = 6    # pairs (b, b+1) masked to residue indices {1, 3, 5}
+
+# How far the word array is spliced right so bit j pairs with the
+# candidate `gap` values above it, per pair kind.
+PAIR_SHIFT = {
+    TWIN_PLAIN: 2, TWIN_ADJ: 1, TWIN_W30: 1,
+    COUSIN_PLAIN: 4, COUSIN_ADJ: 2, COUSIN_W30: 1,
+}
 
 # Tuning knobs (env-overridable for microbenchmarking on real hardware):
 # specs with m <= TIER1_MAX become periodic word patterns (each is an
@@ -159,7 +172,7 @@ def reduce_packed(words, nbits, twin_kind: int, pair_mask,
     if twin_kind == TWIN_NONE:
         twins = jnp.int32(0)
     else:
-        shift = 2 if twin_kind == TWIN_PLAIN else 1
+        shift = PAIR_SHIFT[twin_kind]
         adj = words & _splice_right(words, shift) & pair_mask
         twins = jnp.sum(lax.population_count(adj), dtype=jnp.int32)
 

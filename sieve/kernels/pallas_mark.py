@@ -39,6 +39,22 @@ start-free — see jax_mark.py's docstring):
 All in-kernel control flow is static or fori_loop with static bounds +
 act masks: no scatter, no gather, no data-dependent shapes (the flat
 scatter lives in the XLA postlude, outside the kernel).
+
+Fused reduction (the default path, SIEVE_PALLAS_FUSED=0 reverts): the
+split kernel+postlude design pays two full HBM passes over the bitset per
+segment — the kernel writes Wpad words, reduce_packed reads them all back
+to apply flat clears, corrections, the validity mask, popcount, pair
+counting, and boundary extraction. ``mark_pallas_fused`` folds all of that
+into the marking kernel itself: each (R, 128) tile is patched in VMEM
+(flat clears and corrections applied by per-tile crossing-list cursors, so
+the cost stays proportional to actual crossings), then parked in a
+double-buffered VMEM scratch — tile k's popcount/pair/boundary reduction
+runs while tile k+1 is being marked (no data dependency between them, so
+Mosaic can overlap the two) — and only a uint32[8] SMEM accumulator block
+(count, pairs, first_word, last_spliced + carries) leaves the kernel. A
+``need_bits`` flag additionally emits the patched+masked bitset for
+enumeration/checkpoint consumers. The split path is kept verbatim as the
+parity oracle (tests/test_fused_reduce.py proves bit-exactness).
 """
 
 from __future__ import annotations
@@ -58,10 +74,45 @@ from sieve.kernels.specs import _pair_mask, flat_crossings, tier1_specs
 
 import os as _os
 
+
+def _load_tuned() -> dict:
+    """Hardware-tuned knob values written by tools/autotune_kernel.py.
+
+    Looked up at import from SIEVE_TUNED_JSON or a ``tuned.json`` at the
+    repo root; absent file (the normal state) means built-in defaults.
+    Resolution order per knob: explicit env var > tuned.json > default,
+    so a tuned file never overrides a deliberate env sweep."""
+    import json
+
+    path = _os.environ.get("SIEVE_TUNED_JSON")
+    if path is None:
+        path = _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.dirname(
+                _os.path.abspath(__file__)))),
+            "tuned.json",
+        )
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {k: v for k, v in data.items() if not k.startswith("_")}
+
+
+_TUNED = _load_tuned()
+
+
+def _knob(name: str, default: int) -> int:
+    v = _os.environ.get(name)
+    if v is None:
+        v = _TUNED.get(name, default)
+    return int(v)
+
+
 # Microbenchmarked on TPU v5e. Pre-group-D (n=1e9): R=64 -> 424ms,
 # 128 -> 416ms, 256 -> 406ms, 512 -> 554ms. With group D (n=1e10 segment):
 # 64 -> 914ms, 128 -> 901ms (best), 256 -> 931ms, 512 -> 1007ms.
-R_ROWS = int(_os.environ.get("SIEVE_PALLAS_ROWS", "128"))  # tile = (R, 128) words
+R_ROWS = _knob("SIEVE_PALLAS_ROWS", 128)  # tile = (R, 128) words
 TILE_WORDS = R_ROWS * 128
 NA_PAD = 16                     # group-A slots (>= 11 primes below 32)
 A_LAYERS = 16                   # max marked bits per word (m=2 -> 16)
@@ -71,7 +122,7 @@ B_MAX = 1024
 # split point — only raising it is meaningful (prepare_pallas clamps to the
 # 4096-bit row width, below which the one-hit-per-row invariant breaks);
 # setting it huge routes everything through group C (the pre-D behavior).
-D_MIN = int(_os.environ.get("SIEVE_PALLAS_DMIN", "4096"))
+D_MIN = _knob("SIEVE_PALLAS_DMIN", 4096)
 D_LANES = 128                   # specs per D block (lane dimension)
 # Flat-path cutoff: strides at least this wide leave the kernel entirely —
 # their few crossings are enumerated on host (specs.flat_crossings) and
@@ -86,7 +137,7 @@ _U32 = jnp.uint32
 
 
 def _flat_cutoff(Wpad: int) -> int:
-    v = int(_os.environ.get("SIEVE_PALLAS_FLAT_MIN", "0"))
+    v = _knob("SIEVE_PALLAS_FLAT_MIN", 0)
     if v <= 0:
         v = 32 * Wpad // _FLAT_MAX_HITS
     return max(v, max(D_MIN, 4096) + 1)
@@ -141,7 +192,8 @@ def _group_d_arrays(m: np.ndarray, r: np.ndarray, Wpad: int) -> tuple[np.ndarray
 
 
 def prepare_pallas(
-    packing: str, lo: int, hi: int, seeds: np.ndarray, wpad: int | None = None
+    packing: str, lo: int, hi: int, seeds: np.ndarray,
+    wpad: int | None = None, pair_gap: int = 2,
 ) -> PallasSegment:
     """Host prep for one segment. ``wpad`` overrides the word padding with a
     larger common value (mesh path: every shard must share one shape; the
@@ -200,7 +252,7 @@ def prepare_pallas(
         corr_mask=cm.reshape(1, -1),
         flat_idx=fi.reshape(1, -1),
         flat_mask=fm.reshape(1, -1),
-        pair_mask=_pair_mask(packing, lo),
+        pair_mask=_pair_mask(packing, lo, pair_gap),
     )
 
 
@@ -222,7 +274,8 @@ class PallasChain:
     flat / corrections) for tools/profile_prepare.py and the mesh metrics.
     """
 
-    def __init__(self, packing: str, seeds: np.ndarray, wpad: int):
+    def __init__(self, packing: str, seeds: np.ndarray, wpad: int,
+                 pair_gap: int = 2):
         from sieve.kernels.specs import DeltaModCache, _tier1_strides
 
         if wpad % TILE_WORDS:
@@ -232,6 +285,7 @@ class PallasChain:
         self.packing = packing
         self.seeds = seeds
         self.Wpad = wpad
+        self.pair_gap = pair_gap
         self.layout = get_layout(packing)
         self.phase_seconds = {
             "residue": 0.0, "group": 0.0, "flat": 0.0, "corrections": 0.0,
@@ -345,7 +399,7 @@ class PallasChain:
         ci_pad = np.full(ci.size, -1, np.int32)
         real = cm != 0
         ci_pad[real] = ci[real].astype(np.int32)
-        pair_mask = _pair_mask(self.packing, lo)
+        pair_mask = _pair_mask(self.packing, lo, self.pair_gap)
         t4 = time.perf_counter()
         ph = self.phase_seconds
         ph["residue"] += t1 - t0
@@ -469,13 +523,93 @@ def _onebit(t, act):
     return hit & act
 
 
+def _mark_tile(base, row, lane,
+               Am, ArK, AM1, Arcp1, Arcp, Aact,
+               Bm, BrK, BM1, Brcp1, Brcp, Bact,
+               Cm, CrK, Crcp, Cact,
+               Dm, DrK, Drcp, Dact,
+               SB: int, SC: int, ND: int):
+    """Marking body shared by the split and fused kernels: apply every
+    A/B/C/D spec to the (R, 128)-word tile starting at word ``base`` and
+    return the marked words (1 = still possibly prime)."""
+    w32 = 32 * (base + row * 128 + lane)
+    words = jnp.full((R_ROWS, 128), 0xFFFFFFFF, _U32)
+
+    # --- group A: multi-bit small strides (static unroll) ------------
+    for i in range(NA_PAD):
+        m = Am[0, i]
+        t0 = _mod_two_level(ArK[0, i] - w32, AM1[0, i], Arcp1[0, i],
+                            m, Arcp[0, i])
+        mask = jnp.zeros((R_ROWS, 128), _U32)
+        for k in range(A_LAYERS):
+            bit = t0 + k * m
+            mask = mask | jnp.where(
+                bit < 32, _U32(1) << (bit.astype(_U32) & _U32(31)), _U32(0)
+            )
+        words = words & ~(mask & Aact[0, i])
+
+    # --- group B: two-level mod, one bit -----------------------------
+    def bbody(i, ws):
+        t0 = _mod_two_level(BrK[0, i] - w32, BM1[0, i], Brcp1[0, i],
+                            Bm[0, i], Brcp[0, i])
+        return ws & ~_onebit(t0, Bact[0, i])
+
+    words = lax.fori_loop(0, SB, bbody, words)
+
+    # --- group C: single-level mod, one bit --------------------------
+    def cbody(i, ws):
+        t0 = _mod_single(CrK[0, i] - w32, Cm[0, i], Crcp[0, i])
+        return ws & ~_onebit(t0, Cact[0, i])
+
+    words = lax.fori_loop(0, SC, cbody, words)
+
+    # --- group D: one bit per tile ROW; 128 specs per mod pass -------
+    if ND:
+        # bit offset of each row's first flag (row r covers bits
+        # [rowbit[r], rowbit[r] + 4096) of the padded segment)
+        rowbit = 32 * (base + row * 128)  # (R, 128); lane-constant
+
+        def dbody(i, ws):
+            mD = Dm[pl.ds(i, 1), :]       # (1, 128): lane s = spec s
+            rKD = DrK[pl.ds(i, 1), :]
+            rcpD = Drcp[pl.ds(i, 1), :]
+            actD = Dact[pl.ds(i, 1), :]
+            # t[r, s] = (rK[s] - rowbit[r]) mod m[s]; hit in row r iff
+            # t < 4096, at word t >> 5, bit t & 31
+            y = rKD - rowbit[:, 0:1]      # (R, 128) via broadcast
+            t0 = _mod_single(y, mD, rcpD)
+            hw = t0 >> 5                  # word-in-row per (row, spec)
+            hmask = jnp.where(
+                t0 < 4096, _U32(1) << (t0.astype(_U32) & _U32(31)), _U32(0)
+            ) & actD
+            # Placement: the hit of the spec riding lane s belongs at
+            # lane hw[r, s]. Rotating lanes right by k moves lane s to
+            # lane s + k, so the spec's contribution rides rotation
+            # k = (hw - s) mod 128. OR_k roll(sel_k, k) is evaluated
+            # Horner-style: descending k, rotate the accumulator one
+            # lane and OR in this k's selection — sel_k ends up rotated
+            # exactly k times. Same select count as rotate-by-k, but
+            # every rotation is the cheapest (distance-1) lane shuffle;
+            # still no lane slicing, tiny live state, compile cost
+            # independent of ND.
+            dist = (hw - lane) & 127
+            hit = jnp.where(dist == D_LANES - 1, hmask, _U32(0))
+            for k in range(D_LANES - 2, -1, -1):
+                hit = pltpu.roll(hit, 1, axis=1) | jnp.where(
+                    dist == k, hmask, _U32(0)
+                )
+            return ws & ~hit
+
+        words = lax.fori_loop(0, ND, dbody, words)
+
+    return words
+
+
 def _make_kernel(SB: int, SC: int, ND: int):
     """Pure marking kernel: specs in, marked words out. Corrections, the
     validity mask, counting, twins, and boundary words all happen in the
-    XLA postlude (jax_mark.reduce_packed) — keeping them here cost an
-    unrolled CC-length correction loop and sequential-grid accumulators
-    whose live ranges blew VMEM once every seed prime sat in segment 0
-    (N = 1e12 puts all 78k of them there)."""
+    XLA postlude (jax_mark.reduce_packed) — the split half of the fused /
+    split pair (see _make_fused_kernel for why both exist)."""
 
     def kernel(Am, ArK, AM1, Arcp1, Arcp, Aact,
                Bm, BrK, BM1, Brcp1, Brcp, Bact,
@@ -486,77 +620,14 @@ def _make_kernel(SB: int, SC: int, ND: int):
         base = t * TILE_WORDS
         row = lax.broadcasted_iota(jnp.int32, (R_ROWS, 128), 0)
         lane = lax.broadcasted_iota(jnp.int32, (R_ROWS, 128), 1)
-        w32 = 32 * (base + row * 128 + lane)
-        words = jnp.full((R_ROWS, 128), 0xFFFFFFFF, _U32)
-
-        # --- group A: multi-bit small strides (static unroll) ------------
-        for i in range(NA_PAD):
-            m = Am[0, i]
-            t0 = _mod_two_level(ArK[0, i] - w32, AM1[0, i], Arcp1[0, i],
-                                m, Arcp[0, i])
-            mask = jnp.zeros((R_ROWS, 128), _U32)
-            for k in range(A_LAYERS):
-                bit = t0 + k * m
-                mask = mask | jnp.where(
-                    bit < 32, _U32(1) << (bit.astype(_U32) & _U32(31)), _U32(0)
-                )
-            words = words & ~(mask & Aact[0, i])
-
-        # --- group B: two-level mod, one bit -----------------------------
-        def bbody(i, ws):
-            t0 = _mod_two_level(BrK[0, i] - w32, BM1[0, i], Brcp1[0, i],
-                                Bm[0, i], Brcp[0, i])
-            return ws & ~_onebit(t0, Bact[0, i])
-
-        words = lax.fori_loop(0, SB, bbody, words)
-
-        # --- group C: single-level mod, one bit --------------------------
-        def cbody(i, ws):
-            t0 = _mod_single(CrK[0, i] - w32, Cm[0, i], Crcp[0, i])
-            return ws & ~_onebit(t0, Cact[0, i])
-
-        words = lax.fori_loop(0, SC, cbody, words)
-
-        # --- group D: one bit per tile ROW; 128 specs per mod pass -------
-        if ND:
-            # bit offset of each row's first flag (row r covers bits
-            # [rowbit[r], rowbit[r] + 4096) of the padded segment)
-            rowbit = 32 * (base + row * 128)  # (R, 128); lane-constant
-
-            def dbody(i, ws):
-                mD = Dm[pl.ds(i, 1), :]       # (1, 128): lane s = spec s
-                rKD = DrK[pl.ds(i, 1), :]
-                rcpD = Drcp[pl.ds(i, 1), :]
-                actD = Dact[pl.ds(i, 1), :]
-                # t[r, s] = (rK[s] - rowbit[r]) mod m[s]; hit in row r iff
-                # t < 4096, at word t >> 5, bit t & 31
-                y = rKD - rowbit[:, 0:1]      # (R, 128) via broadcast
-                t0 = _mod_single(y, mD, rcpD)
-                hw = t0 >> 5                  # word-in-row per (row, spec)
-                hmask = jnp.where(
-                    t0 < 4096, _U32(1) << (t0.astype(_U32) & _U32(31)), _U32(0)
-                ) & actD
-                # Placement: the hit of the spec riding lane s belongs at
-                # lane hw[r, s]. Rotating lanes right by k moves lane s to
-                # lane s + k, so the spec's contribution rides rotation
-                # k = (hw - s) mod 128. OR_k roll(sel_k, k) is evaluated
-                # Horner-style: descending k, rotate the accumulator one
-                # lane and OR in this k's selection — sel_k ends up rotated
-                # exactly k times. Same select count as rotate-by-k, but
-                # every rotation is the cheapest (distance-1) lane shuffle;
-                # still no lane slicing, tiny live state, compile cost
-                # independent of ND.
-                dist = (hw - lane) & 127
-                hit = jnp.where(dist == D_LANES - 1, hmask, _U32(0))
-                for k in range(D_LANES - 2, -1, -1):
-                    hit = pltpu.roll(hit, 1, axis=1) | jnp.where(
-                        dist == k, hmask, _U32(0)
-                    )
-                return ws & ~hit
-
-            words = lax.fori_loop(0, ND, dbody, words)
-
-        words_ref[:, :] = words
+        words_ref[:, :] = _mark_tile(
+            base, row, lane,
+            Am, ArK, AM1, Arcp1, Arcp, Aact,
+            Bm, BrK, BM1, Brcp1, Brcp, Bact,
+            Cm, CrK, Crcp, Cact,
+            Dm, DrK, Drcp, Dact,
+            SB, SC, ND,
+        )
 
     return kernel
 
@@ -602,6 +673,294 @@ def _build_call(Wpad: int, SB: int, SC: int, ND: int, interpret: bool):
     return call
 
 
+def tile_offsets(idx: np.ndarray, mask: np.ndarray, Wpad: int) -> np.ndarray:
+    """Per-tile cursors into a word-sorted (idx, mask) crossing list:
+    entries [off[0, t], off[0, t+1]) are exactly those whose global word
+    index falls inside tile t. The fused kernel's patch loops use these as
+    fori_loop bounds, so per-tile patch cost stays proportional to the
+    tile's actual crossings and the padding entries (appended past the
+    real ones by flat_crossings/_corrections/pad_pallas) are never
+    visited."""
+    G = Wpad // TILE_WORDS
+    flat = np.asarray(idx).reshape(-1)
+    n_real = int(np.count_nonzero(np.asarray(mask).reshape(-1)))
+    real = flat[:n_real].astype(np.int64)
+    bounds = np.arange(G + 1, dtype=np.int64) * TILE_WORDS
+    return np.searchsorted(real, bounds, side="left").astype(
+        np.int32).reshape(1, -1)
+
+
+def _make_fused_kernel(G: int, SB: int, SC: int, ND: int,
+                       twin_kind: int, need_bits: bool):
+    """Marking + full reduction in one pallas_call (the tentpole).
+
+    Per grid step t: mark tile t (shared _mark_tile), patch it in VMEM
+    (flat clears then corrections via the per-tile cursor loops, then the
+    validity mask — same order as jax_mark.reduce_packed, the parity
+    oracle), park it in the double-buffered VMEM scratch, and reduce tile
+    t-1 out of the *other* buffer slot. The reduction of tile t-1 has no
+    data dependency on tile t's marking, so Mosaic is free to overlap the
+    two; the sequential grid makes the SMEM accumulator block a legal
+    revisited output.
+
+    Accumulator layout (uint32[1, 8] SMEM output):
+      [0] count   [1] pairs      [2] first_word  [3] last_spliced
+      [4] prev_last carry        [5] word at wl  [6] word at wl+1  [7] -
+
+    Pair counting runs on the bitpacked lanes directly: the right-neighbor
+    word arrives via two cheap rotations (distance-127 lane roll for the
+    in-row neighbor, distance-(R-1) sublane roll for the lane-127 column),
+    and the tile's very last word — whose neighbor lives in the NEXT tile —
+    is masked out and deferred through the prev_last carry. The final
+    tile's deferred pair is provably zero: Wpad >= W + 1 guarantees the
+    last padded word dies under the validity mask.
+
+    Known hardware limit: the correction/flat lists ride SMEM, so a
+    segment whose merged correction list is huge (segment 0 at extreme N)
+    may exceed SMEM on real chips — SIEVE_PALLAS_FUSED=0 falls back to the
+    split kernel + XLA postlude, which has no such limit.
+    """
+    from sieve.kernels.jax_mark import PAIR_SHIFT, TWIN_NONE
+
+    shift = PAIR_SHIFT.get(twin_kind, 0)
+
+    def kernel(*refs):
+        (Am, ArK, AM1, Arcp1, Arcp, Aact,
+         Bm, BrK, BM1, Brcp1, Brcp, Bact,
+         Cm, CrK, Crcp, Cact,
+         Dm, DrK, Drcp, Dact,
+         ci, cm, fi, fm, coff, foff, nb, pm) = refs[:28]
+        acc = refs[28]
+        if need_bits:
+            words_out, buf = refs[29], refs[30]
+        else:
+            words_out, buf = None, refs[29]
+
+        t = pl.program_id(0)
+        base = t * TILE_WORDS
+        row = lax.broadcasted_iota(jnp.int32, (R_ROWS, 128), 0)
+        lane = lax.broadcasted_iota(jnp.int32, (R_ROWS, 128), 1)
+
+        ws = _mark_tile(
+            base, row, lane,
+            Am, ArK, AM1, Arcp1, Arcp, Aact,
+            Bm, BrK, BM1, Brcp1, Brcp, Bact,
+            Cm, CrK, Crcp, Cact,
+            Dm, DrK, Drcp, Dact,
+            SB, SC, ND,
+        )
+
+        # --- in-tile patch: flat clears BEFORE corrections (a flat class
+        # can cross its own seed's bit, which the correction re-sets),
+        # then the validity mask — bit-for-bit the reduce_packed order.
+        widx = base + row * 128 + lane
+
+        def fbody(i, w):
+            return w & ~jnp.where(widx == fi[0, i], fm[0, i], _U32(0))
+
+        ws = lax.fori_loop(foff[0, t], foff[0, t + 1], fbody, ws)
+
+        def cbody(i, w):
+            return w | jnp.where(widx == ci[0, i], cm[0, i], _U32(0))
+
+        ws = lax.fori_loop(coff[0, t], coff[0, t + 1], cbody, ws)
+
+        nbits_s = nb[0, 0]
+        bits_valid = jnp.clip(nbits_s - 32 * widx, 0, 32)
+        part = (_U32(1) << jnp.minimum(bits_valid, 31).astype(_U32)) - _U32(1)
+        ws = ws & jnp.where(bits_valid >= 32, _U32(0xFFFFFFFF), part)
+
+        if need_bits:
+            words_out[:, :] = ws
+
+        # --- park tile t; static-index stores under slot-parity whens
+        # (Mosaic cannot dynamically index the leading scratch dim)
+        slot = lax.rem(t, 2)
+
+        @pl.when(slot == 0)
+        def _():
+            buf[0] = ws
+
+        @pl.when(slot == 1)
+        def _():
+            buf[1] = ws
+
+        @pl.when(t == 0)
+        def _():
+            for j in range(8):
+                acc[0, j] = _U32(0)
+
+        pmask = pm[0, 0]
+        zero = jnp.zeros((R_ROWS, 128), _U32)
+
+        def reduce_tile(k, w):
+            """Fold tile k's fully patched words into the accumulators.
+            Scalar extraction is a masked full-tile sum (Mosaic cannot
+            scalar-load a dynamic position from a vector value)."""
+            kwidx = k * TILE_WORDS + row * 128 + lane
+            acc[0, 0] = acc[0, 0] + jnp.sum(
+                lax.population_count(w), dtype=_U32)
+            fw = jnp.sum(
+                jnp.where((row == 0) & (lane == 0), w, zero), dtype=_U32)
+            lw = jnp.sum(
+                jnp.where((row == R_ROWS - 1) & (lane == 127), w, zero),
+                dtype=_U32)
+            wl = (nbits_s - 32) // 32
+            acc[0, 5] = acc[0, 5] + jnp.sum(
+                jnp.where(kwidx == wl, w, zero), dtype=_U32)
+            acc[0, 6] = acc[0, 6] + jnp.sum(
+                jnp.where(kwidx == wl + 1, w, zero), dtype=_U32)
+            if twin_kind != TWIN_NONE:
+                low = _U32((1 << shift) - 1)
+                nxt1 = pltpu.roll(w, 127, axis=1)   # w[r, l+1 mod 128]
+                nxt = jnp.where(
+                    lane == 127,
+                    pltpu.roll(nxt1, R_ROWS - 1, axis=0),  # w[r+1, 0]
+                    nxt1,
+                )
+                spl = (w >> _U32(shift)) | (nxt & low) << _U32(32 - shift)
+                adj = w & spl & pmask
+                # tile-last word's neighbor lives in the NEXT tile: defer
+                adj = jnp.where(
+                    (row == R_ROWS - 1) & (lane == 127), zero, adj)
+                prev = acc[0, 4]
+                spl_b = (prev >> _U32(shift)) | (fw & low) << _U32(32 - shift)
+                acc[0, 1] = (
+                    acc[0, 1]
+                    + jnp.sum(lax.population_count(adj), dtype=_U32)
+                    + lax.population_count(prev & spl_b & pmask)
+                )
+
+            @pl.when(k == 0)
+            def _():
+                acc[0, 2] = fw
+
+            acc[0, 4] = lw
+
+        @pl.when(t > 0)
+        def _():
+            prev_tile = jnp.where(slot == 0, buf[1], buf[0])
+            reduce_tile(t - 1, prev_tile)
+
+        @pl.when(t == G - 1)
+        def _():
+            reduce_tile(t, ws)
+            # last-boundary splice, reduce_packed's formula verbatim
+            off = nbits_s - 32
+            sh = (off % 32).astype(_U32)
+            spliced = (acc[0, 5] >> sh) | jnp.where(
+                sh == 0, _U32(0), acc[0, 6] << (_U32(32) - sh)
+            )
+            acc[0, 3] = spliced
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _build_fused_call(Wpad: int, SB: int, SC: int, ND: int, CC: int,
+                      FC: int, twin_kind: int, need_bits: bool,
+                      interpret: bool):
+    grid = Wpad // TILE_WORDS
+    kernel = _make_fused_kernel(grid, SB, SC, ND, twin_kind, need_bits)
+    Wrows = Wpad // 128
+
+    def smem(n):
+        return pl.BlockSpec((1, n), lambda t: (0, 0), memory_space=pltpu.SMEM)
+
+    def vmem_rows(nrows):
+        return pl.BlockSpec(
+            (nrows, D_LANES), lambda t: (0, 0), memory_space=pltpu.VMEM
+        )
+
+    in_specs = (
+        [smem(NA_PAD)] * 6
+        + [smem(SB)] * 6
+        + [smem(SC)] * 4
+        + [vmem_rows(max(ND, 1))] * 4
+        + [smem(CC)] * 2          # corr idx / mask
+        + [smem(FC)] * 2          # flat idx / mask
+        + [smem(grid + 1)] * 2    # corr / flat per-tile cursors
+        + [smem(1)] * 2           # nbits, pair_mask
+    )
+    out_specs = [pl.BlockSpec((1, 8), lambda t: (0, 0),
+                              memory_space=pltpu.SMEM)]
+    out_shape = [jax.ShapeDtypeStruct((1, 8), jnp.uint32)]
+    if need_bits:
+        out_specs.append(pl.BlockSpec((R_ROWS, 128), lambda t: (t, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((Wrows, 128), jnp.uint32))
+    call = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs) if need_bits else out_specs[0],
+        out_shape=tuple(out_shape) if need_bits else out_shape[0],
+        scratch_shapes=[pltpu.VMEM((2, R_ROWS, 128), jnp.uint32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+    return call
+
+
+@functools.lru_cache(maxsize=None)
+def _build_fused_jit(Wpad, SB, SC, ND, CC, FC, twin_kind, need_bits,
+                     interpret):
+    call = _build_fused_call(Wpad, SB, SC, ND, CC, FC, twin_kind,
+                             need_bits, interpret)
+    return jax.jit(lambda *a: call(*a))
+
+
+def fused_args(ps: PallasSegment) -> tuple:
+    """The fused call's argument tuple for one prepared segment (host-side
+    numpy; shared by mark_pallas_fused, the mesh step, and the profilers)."""
+    return (
+        tuple(ps.A) + tuple(ps.B) + tuple(ps.C) + tuple(ps.D) + (
+            ps.corr_idx, ps.corr_mask, ps.flat_idx, ps.flat_mask,
+            tile_offsets(ps.corr_idx, ps.corr_mask, ps.Wpad),
+            tile_offsets(ps.flat_idx, ps.flat_mask, ps.Wpad),
+            np.full((1, 1), ps.nbits, np.int32),
+            np.full((1, 1), ps.pair_mask, np.uint32),
+        )
+    )
+
+
+def mark_pallas_fused(ps: PallasSegment, twin_kind: int, interpret: bool,
+                      need_bits: bool = False):
+    """Run the fused mark+reduce kernel; returns (count, pairs, first_word,
+    last_word) — and additionally the patched, validity-masked word array
+    (shape (Wpad//128, 128)) when ``need_bits``. Unlike the split path's
+    raw kernel output, the need_bits words are FINAL: flat clears,
+    corrections, and the beyond-nbits mask are already applied, so
+    enumeration/checkpoint consumers can use them directly."""
+    SB = ps.B[0].shape[1]
+    SC = ps.C[0].shape[1]
+    ND = ps.D[0].shape[0] if ps.D[3].any() else 0
+    CC = ps.corr_idx.shape[1]
+    FC = ps.flat_idx.shape[1]
+    call = _build_fused_jit(ps.Wpad, SB, SC, ND, CC, FC, twin_kind,
+                            need_bits, interpret)
+    out = call(*fused_args(ps))
+    if need_bits:
+        acc, words = out
+        acc = np.asarray(acc)
+        res = tuple(int(v) for v in acc[0, :4])
+        return res, np.asarray(words)
+    acc = np.asarray(out)  # one uint32[1, 8] fetch
+    return tuple(int(v) for v in acc[0, :4])
+
+
+def pallas_fused_enabled() -> bool:
+    """Fused in-kernel reduction is the default; SIEVE_PALLAS_FUSED=0
+    selects the split kernel + XLA-postlude path (the parity oracle).
+    Read per call so tests and dryruns can flip it."""
+    v = _os.environ.get("SIEVE_PALLAS_FUSED")
+    if v is None:
+        v = str(_TUNED.get("SIEVE_PALLAS_FUSED", "1"))
+    return v != "0"
+
+
 def _postlude(words, nbits, pair_mask, ci, cm, twin_kind: int,
               fi=None, fm=None):
     """XLA tail on the kernel's words: flat clears + corrections +
@@ -627,10 +986,11 @@ def _build_call_jit(Wpad, twin_kind, SB, SC, ND, FC, interpret):
     return jax.jit(run, static_argnames=())
 
 
-def mark_pallas(ps: PallasSegment, twin_kind: int, interpret: bool):
+def mark_pallas_split(ps: PallasSegment, twin_kind: int, interpret: bool):
     """Run the marking kernel + XLA postlude; returns (count, twins,
     first_word, last_word). The packed words stay on device; only four
-    scalars cross to the host."""
+    scalars cross to the host. Kept verbatim as the fused path's parity
+    oracle (and the fallback for SMEM-oversized correction lists)."""
     SB = ps.B[0].shape[1]
     SC = ps.C[0].shape[1]
     ND = ps.D[0].shape[0] if ps.D[3].any() else 0
@@ -646,3 +1006,12 @@ def mark_pallas(ps: PallasSegment, twin_kind: int, interpret: bool):
         ps.flat_mask[0, :FC],
     ))  # one uint32[4] fetch: count, twins, first, last
     return int(packed[0]), int(packed[1]), int(packed[2]), int(packed[3])
+
+
+def mark_pallas(ps: PallasSegment, twin_kind: int, interpret: bool):
+    """Segment entry point: fused in-kernel reduction by default,
+    SIEVE_PALLAS_FUSED=0 for the split kernel + postlude. Both return the
+    same (count, pairs, first_word, last_word) quadruple."""
+    if pallas_fused_enabled():
+        return mark_pallas_fused(ps, twin_kind, interpret)
+    return mark_pallas_split(ps, twin_kind, interpret)

@@ -393,15 +393,22 @@ def flat_crossings(
     )
 
 
-def _pair_mask(packing: str, lo: int) -> int:
-    """uint32 mask of bit positions whose (b, b+shift) pair is a twin pair."""
+# wheel30 residue indices whose gidx-NEXT neighbor sits `gap` above it:
+# gap=2 -> (11,13), (17,19), (29,31); gap=4 -> (7,11), (13,17), (19,23).
+_W30_PAIR_IDX = {2: (2, 4, 7), 4: (1, 3, 5)}
+
+
+def _pair_mask(packing: str, lo: int, gap: int = 2) -> int:
+    """uint32 mask of bit positions whose (b, b+shift) splice pair is a
+    prime pair with difference ``gap`` (2 = twins, 4 = cousins)."""
     if packing != "wheel30":
         return 0xFFFFFFFF
+    idxset = _W30_PAIR_IDX[gap]
     layout = get_layout(packing)
     g0 = layout.gidx(layout.first_candidate(lo))
     mask = 0
     for j in range(32):
-        if (g0 + j) % 8 in (2, 4, 7):  # (11,13), (17,19), (29,31) classes
+        if (g0 + j) % 8 in idxset:
             mask |= 1 << j
     return mask
 
@@ -414,6 +421,7 @@ def prepare_tiered(
     tier1_max: int,
     spec_block: int,
     word_bucket: int,
+    pair_gap: int = 2,
 ) -> TieredSegment:
     """Host-side preparation of one segment for the word kernel."""
     specs = marking_specs(packing, lo, hi, seeds)
@@ -456,7 +464,7 @@ def prepare_tiered(
         act2=act2,
         corr_idx=corr_idx,
         corr_mask=corr_mask,
-        pair_mask=_pair_mask(packing, lo),
+        pair_mask=_pair_mask(packing, lo, pair_gap),
     )
 
 
@@ -493,12 +501,14 @@ class TieredChain:
         tier1_max: int,
         spec_block: int,
         word_bucket: int,
+        pair_gap: int = 2,
     ):
         self.packing = packing
         self.seeds = seeds
         self.tier1_max = tier1_max
         self.spec_block = spec_block
         self.word_bucket = word_bucket
+        self.pair_gap = pair_gap
         self.layout = get_layout(packing)
         self._spec = SpecChain(packing, seeds)
         self._big_idx = np.flatnonzero(self._spec.m > tier1_max)
@@ -586,7 +596,7 @@ class TieredChain:
             act2=act2,
             corr_idx=corr_idx,
             corr_mask=corr_mask,
-            pair_mask=_pair_mask(self.packing, lo),
+            pair_mask=_pair_mask(self.packing, lo, self.pair_gap),
         )
 
 

@@ -62,6 +62,9 @@ class MetricsLogger:
             if result.elapsed_s > 0
             else None,
         }
+        kind = getattr(self.config, "count_kind", "primes")
+        if kind not in (None, "primes", "twins"):
+            record["count_kind"] = kind
         phases = getattr(result, "host_phases", None)
         if phases:
             # host-prepare pipeline health alongside the headline rate
@@ -70,6 +73,9 @@ class MetricsLogger:
                 "prep_values_per_sec",
                 "device_idle_frac",
                 "overlap_efficiency",
+                "reduction_mode",
+                "postlude_fused_s",
+                "postlude_split_s",
             ):
                 if key in phases:
                     record[key] = phases[key]

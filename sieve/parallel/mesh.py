@@ -30,7 +30,7 @@ import time
 
 import numpy as np
 
-from sieve.backends.jax_backend import TWIN_KIND
+from sieve.backends.jax_backend import pair_kind
 from sieve.bitset import get_layout
 from sieve.checkpoint import Ledger
 from sieve.config import SieveConfig
@@ -38,7 +38,6 @@ from sieve.coordinator import SieveResult, merge_results
 from sieve.kernels.jax_mark import (
     SPEC_BLOCK,
     TIER1_MAX,
-    TWIN_NONE,
     WORD_BUCKET,
     mark_words_impl,
     next_pow2,
@@ -245,6 +244,39 @@ def _make_pallas_step(mesh_key, Wpad: int, twin_kind: int, SB: int, SC: int,
     return _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs)
 
 
+@functools.lru_cache(maxsize=None)
+def _make_pallas_fused_step(mesh_key, Wpad: int, twin_kind: int, SB: int,
+                            SC: int, ND: int, CC: int, FC: int, ndev: int,
+                            interpret: bool):
+    """Jitted one-round step running the FUSED Pallas kernel per shard: the
+    in-kernel reduction leaves only the (1, 8) SMEM accumulator per shard,
+    which feeds the psum/ppermute collectives directly — no full-width
+    bitset ever crosses back through HBM to an XLA postlude. Arg order
+    mirrors fused_args() with gap_ok appended."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from sieve.kernels.pallas_mark import _build_fused_call
+
+    mesh = _MESHES[mesh_key]
+    smap = _shard_map()
+    call = _build_fused_call(Wpad, SB, SC, ND, CC, FC, twin_kind,
+                             need_bits=False, interpret=interpret)
+
+    def shard_fn(*rest):
+        args = tuple(a[0] for a in rest[:28])  # groups(20) + lists(6) + nb/pm
+        gap_ok = rest[28]
+        acc = call(*args)
+        count = acc[0, 0].astype(jnp.int32)
+        twins = acc[0, 1].astype(jnp.int32)
+        return _collective_merge(count, twins, acc[0, 2], acc[0, 3],
+                                 gap_ok, ndev)
+
+    in_specs = (P("seg"),) * 29
+    out_specs = P()  # one packed replicated vector (see _collective_merge)
+    return _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs)
+
+
 def _broadcast_done(done: dict) -> dict:
     """Replicate process 0's completed-segment map to every process
     (multi-host resume safety — see call site)."""
@@ -310,7 +342,8 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
     cfg = SieveConfig(**{**cfg.to_dict(), "n_segments": n_segs})
 
     seeds = seed_primes(cfg.seed_limit)
-    twin_kind = TWIN_KIND[cfg.packing] if cfg.twins else TWIN_NONE
+    twin_kind = pair_kind(cfg)
+    pgap = getattr(cfg, "pair_gap", 2) or 2
     # Shared shapes are derived from the segment plan and the chain's
     # segment-independent structure — no upfront prepare of any segment.
     # Corrections-word bound: one word per seed prime in range at most.
@@ -332,25 +365,30 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
             TILE_WORDS,
             PallasChain,
             pad_pallas,
+            pallas_fused_enabled,
+            tile_offsets,
         )
 
         Wmax = max(-(-layout.nbits(s.lo, s.hi) // 32) for s in segs)
         Wpad = -(-(Wmax + 1) // TILE_WORDS) * TILE_WORDS
-        template = PallasChain(cfg.packing, seeds, Wpad)
+        template = PallasChain(cfg.packing, seeds, Wpad, pair_gap=pgap)
         SB = template.SB
         SC = template.SC
         interpret = mesh.devices.flat[0].platform == "cpu"
+        # reduction mode is fixed once per run (not per round) so every
+        # round of a run compiles and cross-checks the same path
+        fused = pallas_fused_enabled()
         step = None  # built per round (shape-bucketed) in the loop below
 
         def _make_chain():
-            return PallasChain(cfg.packing, seeds, Wpad)
+            return PallasChain(cfg.packing, seeds, Wpad, pair_gap=pgap)
     else:
         Wseg = [-(-layout.nbits(s.lo, s.hi) // 32) for s in segs]
         Wpad = max(
             -(-(W + 1) // WORD_BUCKET) * WORD_BUCKET for W in Wseg
         )
         template = TieredChain(cfg.packing, seeds, TIER1_MAX, SPEC_BLOCK,
-                               WORD_BUCKET)
+                               WORD_BUCKET, pair_gap=pgap)
         periods = template.periods
         # every segment's live tier-2 set is a subset of the chain's
         # tier-2 specs; padding to the (pow2-bucketed) full count is inert
@@ -362,7 +400,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
 
         def _make_chain():
             return TieredChain(cfg.packing, seeds, TIER1_MAX, SPEC_BLOCK,
-                               WORD_BUCKET)
+                               WORD_BUCKET, pair_gap=pgap)
 
     def _pad1(a, n, fill=0):
         if a.size == n:
@@ -417,7 +455,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                 hi=s.hi,
                 count=int(counts[i]) + layout.extras_in(s.lo, s.hi),
                 twin_count=(
-                    int(twins_v[i]) + layout.extra_twin_pairs(s.lo, s.hi)
+                    int(twins_v[i]) + layout.extra_pairs(s.lo, s.hi, pgap)
                     if cfg.twins
                     else 0
                 ),
@@ -438,7 +476,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                 f"psum/count mismatch: collective total {total} != "
                 f"host sum {int(counts.sum())}"
             )
-        if cfg.twins and cfg.packing == "odds":
+        if cfg.twins and cfg.packing == "odds" and pgap == 2:
             from sieve.twins import straddle_twins
 
             batch_res = [done[s.seg_id] for s in batch]
@@ -487,9 +525,10 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
             nbits_v = np.array([p.nbits for p in preps], np.int32)
             # gap_ok[d] = 1 iff (last candidate of seg d, first of seg d+1)
             # is a potential twin pair (values differ by 2) — odds
-            # on-device straddle
+            # on-device straddle. Cousins (gap 4) resolve their straddles
+            # host-side in merge_results; the device straddle stays off.
             gap_ok = np.zeros(ndev, np.int32)
-            if cfg.packing == "odds" and cfg.twins:
+            if cfg.packing == "odds" and cfg.twins and pgap == 2:
                 for i in range(len(batch) - 1):
                     lv = layout.last_candidate(batch[i].hi)
                     fv = layout.first_candidate(batch[i + 1].lo)
@@ -506,10 +545,16 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                     pad_pallas(p, SB, SC, max(ND_r, 1), CC, FC_r)
                     for p in preps
                 ]
-                rstep = _make_pallas_step(
-                    mesh_key, Wpad, twin_kind, SB, SC, ND_r, CC, FC_r, ndev,
-                    interpret,
-                )
+                if fused:
+                    rstep = _make_pallas_fused_step(
+                        mesh_key, Wpad, twin_kind, SB, SC, max(ND_r, 1), CC,
+                        FC_r, ndev, interpret,
+                    )
+                else:
+                    rstep = _make_pallas_step(
+                        mesh_key, Wpad, twin_kind, SB, SC, ND_r, CC, FC_r,
+                        ndev, interpret,
+                    )
                 if multihost:
                     rstep = (lambda *a, _r=rstep: _r(*_globalize(mesh, a)))
                 groups = [
@@ -521,18 +566,44 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                 ] + [
                     np.stack([p.D[i] for p in preps]) for i in range(4)
                 ]
-                args = (
-                    nbits_v.reshape(-1, 1, 1),
-                    np.array(
-                        [p.pair_mask for p in preps], np.uint32
-                    ).reshape(-1, 1, 1),
-                    *groups,
-                    np.stack([p.corr_idx for p in preps]),
-                    np.stack([p.corr_mask for p in preps]),
-                    np.stack([p.flat_idx for p in preps]),
-                    np.stack([p.flat_mask for p in preps]),
-                    gap_ok,
-                )
+                if fused:
+                    # fused_args() order per shard, stacked over 'seg':
+                    # tile cursors are derived from the PADDED lists (pad
+                    # entries carry zero masks, so searchsorted over the
+                    # real prefix is unaffected)
+                    args = (
+                        *groups,
+                        np.stack([p.corr_idx for p in preps]),
+                        np.stack([p.corr_mask for p in preps]),
+                        np.stack([p.flat_idx for p in preps]),
+                        np.stack([p.flat_mask for p in preps]),
+                        np.stack([
+                            tile_offsets(p.corr_idx, p.corr_mask, Wpad)
+                            for p in preps
+                        ]),
+                        np.stack([
+                            tile_offsets(p.flat_idx, p.flat_mask, Wpad)
+                            for p in preps
+                        ]),
+                        nbits_v.astype(np.int32).reshape(-1, 1, 1),
+                        np.array(
+                            [p.pair_mask for p in preps], np.uint32
+                        ).reshape(-1, 1, 1),
+                        gap_ok,
+                    )
+                else:
+                    args = (
+                        nbits_v.reshape(-1, 1, 1),
+                        np.array(
+                            [p.pair_mask for p in preps], np.uint32
+                        ).reshape(-1, 1, 1),
+                        *groups,
+                        np.stack([p.corr_idx for p in preps]),
+                        np.stack([p.corr_mask for p in preps]),
+                        np.stack([p.flat_idx for p in preps]),
+                        np.stack([p.flat_mask for p in preps]),
+                        gap_ok,
+                    )
                 t_stack = time.perf_counter()
                 out = rstep(*args)
             else:
@@ -600,6 +671,8 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
         ),
         **{f"prep_{k}_s": round(v, 6) for k, v in chain_phases.items()},
     }
+    if use_pallas:
+        host_phases["reduction_mode"] = "fused" if fused else "split"
     metrics.event("host_prepare", **host_phases)
 
     result = SieveResult(

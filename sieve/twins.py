@@ -41,16 +41,18 @@ def is_prime_from_boundary(layout: Layout, seg: SegmentResult, v: int) -> bool:
     return bool((seg.last_word >> off) & 1)
 
 
-def straddle_twins(
-    layout: Layout, left: SegmentResult, right: SegmentResult, n: int
+def straddle_pairs(
+    layout: Layout, left: SegmentResult, right: SegmentResult, n: int,
+    gap: int = 2,
 ) -> int:
-    """Twin pairs (v, v+2) with v in `left`, v+2 in `right` (consecutive)."""
+    """Prime pairs (v, v+gap) with v in `left`, v+gap in `right`
+    (consecutive segments); gap is 2 (twins) or 4 (cousins)."""
     if left.hi != right.lo:
         raise ValueError("segments are not consecutive")
     hi = left.hi
     total = 0
-    for v in (hi - 2, hi - 1):
-        w = v + 2
+    for v in range(hi - gap, hi):
+        w = v + gap
         if v < left.lo or w < hi or w > n:
             continue
         if w >= right.hi:
@@ -64,3 +66,10 @@ def straddle_twins(
         if right_prime and is_prime_from_boundary(layout, left, v):
             total += 1
     return total
+
+
+def straddle_twins(
+    layout: Layout, left: SegmentResult, right: SegmentResult, n: int
+) -> int:
+    """Twin pairs (v, v+2) with v in `left`, v+2 in `right` (consecutive)."""
+    return straddle_pairs(layout, left, right, n, 2)

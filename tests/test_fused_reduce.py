@@ -1,0 +1,263 @@
+"""Coverage for the fused in-kernel reduction (ISSUE 3): bit-exact parity
+against the split kernel + XLA-postlude oracle across packings, pair
+kinds, sliver/boundary segments and need_bits on/off; flat-cutoff
+invariance; the --count-kind plug point (config, CLI, backends, merge);
+the tuned.json knob loader; and the fused mesh step vs the split one.
+"""
+
+import dataclasses
+import json
+import math
+
+import numpy as np
+import pytest
+
+from sieve.config import SieveConfig
+from sieve.seed import seed_primes
+
+# (twin kind, cousin kind) per packing — the device splice ids
+_KINDS = {
+    "odds": ("twins", "cousins"),
+    "wheel30": ("twins", "cousins"),
+    "plain": ("twins", "cousins"),
+}
+# one multi-tile segment and one in-tile sliver (odd, non-word-aligned
+# bounds) per packing; spans sized to keep interpret mode quick
+_SEGMENTS = {
+    "odds": [(2_000_003, 6_000_001), (1_001, 33_001)],
+    "wheel30": [(2, 3_000_001), (1_013, 37_017)],
+    "plain": [(2, 500_002), (977, 40_001)],
+}
+
+
+def _kind(packing: str, gapname: str) -> int:
+    from sieve.kernels.jax_mark import (
+        COUSIN_ADJ,
+        COUSIN_PLAIN,
+        COUSIN_W30,
+        TWIN_ADJ,
+        TWIN_NONE,
+        TWIN_PLAIN,
+        TWIN_W30,
+    )
+
+    if gapname == "none":
+        return TWIN_NONE
+    table = {
+        ("plain", "twins"): TWIN_PLAIN,
+        ("odds", "twins"): TWIN_ADJ,
+        ("wheel30", "twins"): TWIN_W30,
+        ("plain", "cousins"): COUSIN_PLAIN,
+        ("odds", "cousins"): COUSIN_ADJ,
+        ("wheel30", "cousins"): COUSIN_W30,
+    }
+    return table[(packing, gapname)]
+
+
+@pytest.mark.parametrize("packing", list(_SEGMENTS))
+@pytest.mark.parametrize("gapname", ["none", "twins", "cousins"])
+def test_fused_vs_split_parity(packing, gapname):
+    """The acceptance bar: fused returns bit-exact (count, pairs, first,
+    last) vs the split kernel + reduce_packed across pair kinds and both
+    a multi-tile segment and a sliver with unaligned boundary words."""
+    from sieve.kernels.pallas_mark import (
+        mark_pallas_fused,
+        mark_pallas_split,
+        prepare_pallas,
+    )
+
+    gap = 4 if gapname == "cousins" else 2
+    kind = _kind(packing, gapname)
+    for lo, hi in _SEGMENTS[packing]:
+        seeds = seed_primes(math.isqrt(hi - 1))
+        ps = prepare_pallas(packing, lo, hi, seeds, pair_gap=gap)
+        fused = mark_pallas_fused(ps, kind, interpret=True)
+        split = mark_pallas_split(ps, kind, interpret=True)
+        assert fused == split, (packing, gapname, lo, hi)
+
+
+def test_fused_need_bits_words_are_final():
+    """need_bits=True must return the SAME scalars plus the final word
+    array: flat clears, corrections and the beyond-nbits validity mask
+    already applied — checked bit-for-bit against the numpy reference."""
+    from sieve.backends.cpu_numpy import sieve_segment_flags
+    from sieve.kernels.jax_mark import TWIN_ADJ
+    from sieve.kernels.pallas_mark import mark_pallas_fused, prepare_pallas
+
+    lo, hi = 2_000_003, 6_000_001
+    seeds = seed_primes(math.isqrt(hi - 1))
+    ps = prepare_pallas("odds", lo, hi, seeds)
+    scalars = mark_pallas_fused(ps, TWIN_ADJ, interpret=True)
+    scalars_nb, words = mark_pallas_fused(
+        ps, TWIN_ADJ, interpret=True, need_bits=True
+    )
+    assert scalars_nb == scalars
+    flags = sieve_segment_flags("odds", lo, hi, seeds)
+    padded = np.zeros(ps.Wpad * 32, bool)
+    padded[: flags.size] = flags
+    want = (
+        (padded.reshape(-1, 32).astype(np.uint32)
+         << np.arange(32, dtype=np.uint32)).sum(axis=1, dtype=np.uint32)
+    ).reshape(-1, 128)
+    assert np.array_equal(np.asarray(words), want)
+
+
+def test_fused_flat_min_invariance(monkeypatch):
+    """Property: the fused result must be invariant under the
+    SIEVE_PALLAS_FLAT_MIN cutoff — moving strides between group D and the
+    in-kernel flat crossing loop reshapes the work, never the answer."""
+    from sieve.kernels.jax_mark import TWIN_ADJ
+    from sieve.kernels.pallas_mark import (
+        mark_pallas_fused,
+        prepare_pallas,
+        spec_counts,
+    )
+
+    lo, hi = 2_000_003, 12_000_001  # seeds to 5477: strides > 4096 live
+    seeds = seed_primes(5477)
+    baseline = mark_pallas_fused(
+        prepare_pallas("odds", lo, hi, seeds), TWIN_ADJ, interpret=True
+    )
+    flat_word_counts = set()
+    for flat_min in (4097, 5477, 5478):
+        monkeypatch.setenv("SIEVE_PALLAS_FLAT_MIN", str(flat_min))
+        ps = prepare_pallas("odds", lo, hi, seeds)
+        flat_word_counts.add(spec_counts(ps)["flat_words"])
+        got = mark_pallas_fused(ps, TWIN_ADJ, interpret=True)
+        assert got == baseline, f"flat_min={flat_min}"
+    assert len(flat_word_counts) > 1, "cutoffs never moved any stride"
+
+
+def test_tile_offsets_cursors():
+    from sieve.kernels.pallas_mark import TILE_WORDS, tile_offsets
+
+    Wpad = 3 * TILE_WORDS
+    idx = np.array(
+        [[5, TILE_WORDS - 1, TILE_WORDS, 2 * TILE_WORDS + 7, 0, 0]], np.int32
+    )
+    mask = np.array([[1, 1, 1, 1, 0, 0]], np.uint32)  # 2 pad entries
+    off = tile_offsets(idx, mask, Wpad)
+    assert off.tolist() == [[0, 2, 3, 4]]
+    # empty list: all cursors collapse to zero
+    assert tile_offsets(
+        np.zeros((1, 4), np.int32), np.zeros((1, 4), np.uint32), Wpad
+    ).tolist() == [[0, 0, 0, 0]]
+
+
+def test_tuned_json_loader(monkeypatch, tmp_path):
+    import sieve.kernels.pallas_mark as pm
+
+    path = tmp_path / "tuned.json"
+    path.write_text(json.dumps(
+        {"SIEVE_PALLAS_DMIN": 8192, "_meta": {"platform": "test"}}
+    ))
+    monkeypatch.setenv("SIEVE_TUNED_JSON", str(path))
+    assert pm._load_tuned() == {"SIEVE_PALLAS_DMIN": 8192}  # _meta filtered
+    monkeypatch.setenv("SIEVE_TUNED_JSON", str(tmp_path / "absent.json"))
+    assert pm._load_tuned() == {}
+
+    # resolution order: env var > tuned.json > default
+    monkeypatch.setattr(pm, "_TUNED", {"SIEVE_PALLAS_DMIN": 8192})
+    monkeypatch.delenv("SIEVE_PALLAS_DMIN", raising=False)
+    assert pm._knob("SIEVE_PALLAS_DMIN", 4096) == 8192
+    monkeypatch.setenv("SIEVE_PALLAS_DMIN", "16384")
+    assert pm._knob("SIEVE_PALLAS_DMIN", 4096) == 16384
+    monkeypatch.setattr(pm, "_TUNED", {})
+    monkeypatch.delenv("SIEVE_PALLAS_DMIN", raising=False)
+    assert pm._knob("SIEVE_PALLAS_DMIN", 4096) == 4096
+
+    # the fused toggle honors tuned.json too, with env winning
+    monkeypatch.setattr(pm, "_TUNED", {"SIEVE_PALLAS_FUSED": "0"})
+    monkeypatch.delenv("SIEVE_PALLAS_FUSED", raising=False)
+    assert pm.pallas_fused_enabled() is False
+    monkeypatch.setenv("SIEVE_PALLAS_FUSED", "1")
+    assert pm.pallas_fused_enabled() is True
+
+
+def _pairs_oracle(n: int, gap: int) -> int:
+    sieve = np.ones(n + 1, bool)
+    sieve[:2] = False
+    for p in range(2, math.isqrt(n) + 1):
+        if sieve[p]:
+            sieve[p * p :: p] = False
+    pr = np.flatnonzero(sieve)
+    pr = pr[pr + gap <= n]
+    return int(np.count_nonzero(sieve[pr + gap]))
+
+
+@pytest.mark.parametrize("backend", ["cpu-numpy", "cpu-native", "jax",
+                                     "tpu-pallas"])
+@pytest.mark.parametrize("packing", ["plain", "odds", "wheel30"])
+def test_count_kind_cousins_all_backends(backend, packing):
+    """--count-kind cousins through every backend and packing, multi-
+    segment so the gap-4 straddle merge is exercised, against a brute
+    numpy oracle."""
+    from sieve.coordinator import run_local
+
+    n = 300_000
+    cfg = SieveConfig(n=n, backend=backend, packing=packing,
+                      count_kind="cousins", n_segments=3, quiet=True)
+    res = run_local(cfg)
+    assert res.pi == 25_997
+    assert res.twin_pairs == _pairs_oracle(n, 4)
+
+
+def test_count_kind_config_normalization():
+    cfg = SieveConfig(n=100, count_kind="cousins")
+    assert cfg.twins and cfg.pair_gap == 4
+    cfg = SieveConfig(n=100, twins=True)
+    assert cfg.count_kind == "twins" and cfg.pair_gap == 2
+    cfg = SieveConfig(n=100)
+    assert cfg.count_kind == "primes" and cfg.pair_gap == 0
+    with pytest.raises(ValueError):
+        SieveConfig(n=100, count_kind="sexy")
+
+
+def test_count_kind_cli():
+    from sieve.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args(["--n", "1000", "--count-kind",
+                                      "cousins"])
+    cfg = config_from_args(args)
+    assert cfg.count_kind == "cousins" and cfg.twins
+    args = build_parser().parse_args(["--n", "1000", "--twins"])
+    assert config_from_args(args).count_kind == "twins"
+    args = build_parser().parse_args(["--n", "1000", "--twins",
+                                      "--count-kind", "cousins"])
+    with pytest.raises(ValueError, match="conflicts"):
+        config_from_args(args)
+
+
+def test_mesh_fused_vs_split(monkeypatch):
+    """8-way mesh: the fused shard step must match the split one on every
+    per-segment field, and both must report their reduction_mode."""
+    from sieve.parallel.mesh import run_mesh
+
+    cfg = SieveConfig(n=3_000_000, backend="tpu-pallas", packing="odds",
+                      workers=8, rounds=1, twins=True, quiet=True)
+    monkeypatch.delenv("SIEVE_PALLAS_FUSED", raising=False)
+    fused = run_mesh(cfg)
+    monkeypatch.setenv("SIEVE_PALLAS_FUSED", "0")
+    split = run_mesh(cfg)
+    assert (fused.host_phases or {}).get("reduction_mode") == "fused"
+    assert (split.host_phases or {}).get("reduction_mode") == "split"
+    assert fused.pi == split.pi == 216_816
+    assert fused.twin_pairs == split.twin_pairs
+    strip = lambda s: {k: v for k, v in dataclasses.asdict(s).items()
+                       if k != "elapsed_s"}
+    for a, b in zip(fused.segments, split.segments):
+        assert strip(a) == strip(b)
+
+
+def test_local_pallas_reports_fused_phase():
+    """run_local on tpu-pallas surfaces reduction_mode and the
+    postlude_fused phase through SieveResult.host_phases."""
+    from sieve.coordinator import run_local
+
+    cfg = SieveConfig(n=1_000_000, backend="tpu-pallas", packing="odds",
+                      n_segments=1, twins=True, quiet=True)
+    res = run_local(cfg)
+    assert res.pi == 78_498
+    ph = res.host_phases or {}
+    assert ph.get("reduction_mode") == "fused"
+    assert ph.get("postlude_fused_s", 0) > 0

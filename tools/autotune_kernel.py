@@ -1,0 +1,161 @@
+"""Autotune the pallas kernel knobs on the machine at hand.
+
+Sweeps SIEVE_PALLAS_ROWS (the fused-tile size), SIEVE_PALLAS_DMIN (the
+C/D split point) and SIEVE_PALLAS_FLAT_MIN (the kernel-exit cutoff) by
+coordinate descent and writes the winning values to ``tuned.json`` at the
+repo root, which sieve/kernels/pallas_mark.py loads at import (resolution
+per knob: explicit env var > tuned.json > built-in default). ROADMAP
+flagged that the built-in defaults were chosen in interpret mode; run
+this once on real hardware to replace them with measured ones.
+
+Each trial runs in a FRESH interpreter (the knobs are read at module
+import) via ``--measure`` self-invocation, timing the warm fused
+mark+reduce on one segment; the parent rejects any knob setting whose
+(count, pairs, first, last) result differs from the baseline's, so a
+fast-but-wrong configuration can never be written to tuned.json.
+
+Usage: python tools/autotune_kernel.py [span] [lo]
+
+    span  window size in values (default 1e9 on TPU, 3e6 in interpret
+          mode — interpret timings rank knobs only roughly)
+    lo    window start (default 2; use 999000000000 for the depth regime)
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KNOBS = {
+    "SIEVE_PALLAS_ROWS": [64, 128, 256],
+    "SIEVE_PALLAS_DMIN": [4096, 8192, 16384],
+    # 0 = the crossings-proportional auto cutoff; explicit values bracket
+    # it for depth-regime windows
+    "SIEVE_PALLAS_FLAT_MIN": [0, 1 << 22, 1 << 24, 1 << 26],
+}
+DEFAULTS = {
+    "SIEVE_PALLAS_ROWS": 128,
+    "SIEVE_PALLAS_DMIN": 4096,
+    "SIEVE_PALLAS_FLAT_MIN": 0,
+}
+
+
+def measure(span: int, lo: int) -> None:
+    """Child mode: knobs arrive via env; print one JSON line and exit."""
+    import jax
+
+    from sieve.kernels.jax_mark import TWIN_ADJ
+    from sieve.kernels.pallas_mark import mark_pallas_fused, prepare_pallas
+    from sieve.seed import seed_primes
+
+    hi = lo + span
+    seeds = seed_primes(math.isqrt(hi - 1))
+    ps = prepare_pallas("odds", lo, hi, seeds)
+    interpret = jax.devices()[0].platform != "tpu"
+    res = mark_pallas_fused(ps, TWIN_ADJ, interpret)  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = mark_pallas_fused(ps, TWIN_ADJ, interpret)
+        best = min(best, time.perf_counter() - t0)
+        assert out == res, "nondeterministic kernel result"
+    print(json.dumps({"seconds": best, "result": list(res)}))
+
+
+def run_trial(knobs: dict, span: int, lo: int) -> dict | None:
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in knobs.items()})
+    # a pre-existing tuned.json must not leak into the trial being measured
+    env["SIEVE_TUNED_JSON"] = os.devnull
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--measure",
+         str(span), str(lo)],
+        env=env, capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        print(f"    trial {knobs} FAILED:\n{proc.stderr.strip()[-500:]}",
+              file=sys.stderr)
+        return None
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure":
+        measure(int(float(sys.argv[2])), int(float(sys.argv[3])))
+        return 0
+
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    span = (
+        int(float(sys.argv[1])) if len(sys.argv) > 1
+        else (10**9 if on_tpu else 3 * 10**6)
+    )
+    lo = int(float(sys.argv[2])) if len(sys.argv) > 2 else 2
+    print(f"autotune: span={span:.0e} lo={lo} "
+          f"({'TPU' if on_tpu else 'interpret mode — rankings are rough'})")
+
+    best = dict(DEFAULTS)
+    base = run_trial(best, span, lo)
+    if base is None:
+        print("baseline trial failed; nothing written", file=sys.stderr)
+        return 1
+    best_s = base["seconds"]
+    oracle = base["result"]
+    print(f"baseline {best}: {best_s * 1e3:.1f} ms  result={oracle}")
+
+    for name, candidates in KNOBS.items():
+        for val in candidates:
+            if val == best[name]:
+                continue
+            trial = {**best, name: val}
+            out = run_trial(trial, span, lo)
+            if out is None:
+                continue
+            if out["result"] != oracle:
+                print(f"  {name}={val}: REJECTED (result {out['result']} "
+                      f"!= {oracle})")
+                continue
+            print(f"  {name}={val}: {out['seconds'] * 1e3:.1f} ms")
+            if out["seconds"] < best_s:
+                best_s = out["seconds"]
+                best = trial
+        print(f"--> {name} = {best[name]}")
+
+    path = os.path.join(REPO_ROOT, "tuned.json")
+    payload = {
+        **{k: int(v) for k, v in best.items()},
+        "_meta": {
+            "span": span,
+            "lo": lo,
+            "platform": "tpu" if on_tpu else "interpret",
+            "best_ms": round(best_s * 1e3, 2),
+            "result": oracle,
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    print(f"wrote {path}: {json.dumps({k: best[k] for k in sorted(best)})}")
+    if not on_tpu:
+        print("note: interpret-mode timings tune vector-op counts, not HBM "
+              "behavior; re-run on hardware before trusting these numbers")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,6 +88,21 @@ def main():
     dt, _ = t(lambda: jax.block_until_ready(full(*fargs)))
     print(f"kernel + postlude (device): {dt*1e3:9.1f} ms")
 
+    # fused mark+reduce (single pallas_call, no postlude round trip);
+    # in-kernel reduce cost ~= fused minus the kernel-only mark pass
+    from sieve.kernels.pallas_mark import _build_fused_jit, fused_args
+
+    CC = ps.corr_idx.shape[1]
+    FCf = ps.flat_idx.shape[1]
+    fused = _build_fused_jit(ps.Wpad, SB, SC, ND, CC, FCf, 1, False, False)
+    fa = fused_args(ps)
+    jax.block_until_ready(fused(*fa))
+    dt_mark, _ = t(lambda: jcall(*args).block_until_ready())
+    dt, _ = t(lambda: jax.block_until_ready(fused(*fa)))
+    print(f"fused mark+reduce (device): {dt*1e3:9.1f} ms   "
+          f"(mark {dt_mark*1e3:.1f} ms, in-kernel reduce "
+          f"~{max(0.0, dt - dt_mark)*1e3:.1f} ms)")
+
     # whole mark_pallas incl. host->device transfers of specs
     dt, _ = t(lambda: mark_pallas(ps, 1, False))
     print(f"mark_pallas end-to-end:     {dt*1e3:9.1f} ms")
