@@ -7,10 +7,9 @@ is parity-tested against. Slow is fine; correct is mandatory.
 
 from __future__ import annotations
 
-import time
-
 import numpy as np
 
+from sieve import trace
 from sieve.bitset import boundary_words, get_layout
 from sieve.worker import SegmentResult, SieveWorker
 
@@ -40,15 +39,21 @@ class CpuNumpyWorker(SieveWorker):
     def process_segment(
         self, lo: int, hi: int, seed_primes: np.ndarray, seg_id: int = 0
     ) -> SegmentResult:
-        t0 = time.perf_counter()
-        layout = get_layout(self.config.packing)
-        flags = sieve_segment_flags(self.config.packing, lo, hi, seed_primes)
-        count = int(np.count_nonzero(flags)) + layout.extras_in(lo, hi)
-        gap = getattr(self.config, "pair_gap", 2) or 2
-        twin_count = (
-            layout.pairs_internal(flags, lo, hi, gap) if self.config.twins else 0
-        )
-        first_word, last_word = boundary_words(flags)
+        with trace.span(
+            "segment.mark", backend=self.name, seg=seg_id
+        ) as sp:
+            layout = get_layout(self.config.packing)
+            flags = sieve_segment_flags(
+                self.config.packing, lo, hi, seed_primes
+            )
+            count = int(np.count_nonzero(flags)) + layout.extras_in(lo, hi)
+            gap = getattr(self.config, "pair_gap", 2) or 2
+            twin_count = (
+                layout.pairs_internal(flags, lo, hi, gap)
+                if self.config.twins
+                else 0
+            )
+            first_word, last_word = boundary_words(flags)
         return SegmentResult(
             seg_id=seg_id,
             lo=lo,
@@ -58,5 +63,5 @@ class CpuNumpyWorker(SieveWorker):
             first_word=first_word,
             last_word=last_word,
             nbits=int(flags.size),
-            elapsed_s=time.perf_counter() - t0,
+            elapsed_s=sp.elapsed,
         )

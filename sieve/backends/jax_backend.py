@@ -18,6 +18,7 @@ import time
 
 import numpy as np
 
+from sieve import trace
 from sieve.backends.cpu_numpy import CpuNumpyWorker
 from sieve.bitset import get_layout
 from sieve.kernels.jax_mark import (
@@ -110,9 +111,11 @@ class JaxWorker(SieveWorker):
         if nbits < MIN_DEVICE_BITS:
             return self._cpu_fallback.process_segment(lo, hi, seed_primes, seg_id)
 
-        ts = self._prepare(packing, lo, hi, seed_primes)
+        with trace.span("segment.prepare", backend=self.name, seg=seg_id):
+            ts = self._prepare(packing, lo, hi, seed_primes)
         twin_kind = pair_kind(self.config)
-        with self._placement():
+        with trace.span("segment.device", backend=self.name, seg=seg_id), \
+                self._placement():
             packed = np.asarray(mark_words(
                 ts.Wpad,
                 twin_kind,

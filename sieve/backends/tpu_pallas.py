@@ -24,6 +24,7 @@ import time
 
 import numpy as np
 
+from sieve import trace
 from sieve.backends.cpu_numpy import CpuNumpyWorker
 from sieve.backends.jax_backend import MIN_DEVICE_BITS, pair_kind
 from sieve.bitset import get_layout
@@ -93,19 +94,21 @@ class PallasWorker(SieveWorker):
         if nbits < MIN_DEVICE_BITS:
             return self._cpu_fallback.process_segment(lo, hi, seed_primes, seg_id)
 
-        ps = self._prepare(packing, lo, hi, seed_primes)
+        with trace.span("segment.prepare", backend=self.name, seg=seg_id):
+            ps = self._prepare(packing, lo, hi, seed_primes)
         twin_kind = pair_kind(self.config)
         self.reduction_mode = (
             "fused" if pallas_fused_enabled() else "split"
         )
-        t_dev = time.perf_counter()
-        with self._placement():
+        key = "postlude_" + self.reduction_mode
+        with trace.span(
+            "segment.device", backend=self.name, seg=seg_id, mode=key
+        ) as sp, self._placement():
             count, twins, first_word, last_word = mark_pallas(
                 ps, twin_kind, self._interpret
             )
-        key = "postlude_" + self.reduction_mode
         self.reduce_seconds[key] = (
-            self.reduce_seconds.get(key, 0.0) + time.perf_counter() - t_dev
+            self.reduce_seconds.get(key, 0.0) + sp.elapsed
         )
         count += layout.extras_in(lo, hi)
         twin_count = (

@@ -65,10 +65,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rounds", type=int, default=1,
                    help="TPU dispatch rounds (failure-recovery granularity)")
     p.add_argument("--profile-dir", default=None)
-    p.add_argument("--quiet", action="store_true")
+    p.add_argument("--trace", default=None, dest="trace_file", metavar="FILE",
+                   help="write host-side spans (round phases, prepare "
+                        "threads, per-segment timings) as Chrome "
+                        "trace-event JSON — open in Perfetto or "
+                        "chrome://tracing; see tools/trace_report.py")
+    p.add_argument("--metrics-file", default=None, dest="metrics_file",
+                   metavar="FILE",
+                   help="append every metrics event as JSONL (including "
+                        "per-segment events suppressed on stderr by "
+                        "--quiet)")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress per-segment stderr lines (the run "
+                        "summary and robustness events still print)")
     p.add_argument("--json", action="store_true", dest="json_output")
     p.add_argument("--chaos-kill-worker", default=None, dest="chaos_kill",
-                   help="fault injection: 'k@s' kills worker k at segment s")
+                   help="fault injection: 'k@s' kills worker k at segment s "
+                        "('any@s': whichever worker draws segment s)")
     p.add_argument("--role", choices=("auto", "coordinator", "worker"), default="auto",
                    help="cpu-cluster role (worker processes connect to --coordinator-addr)")
     p.add_argument("--coordinator-addr", default="127.0.0.1:7621")
@@ -95,6 +108,8 @@ def config_from_args(args: argparse.Namespace) -> SieveConfig:
         resume=args.resume,
         rounds=args.rounds,
         profile_dir=args.profile_dir,
+        trace_file=args.trace_file,
+        metrics_file=args.metrics_file,
         quiet=args.quiet,
         json_output=args.json_output,
         chaos_kill=args.chaos_kill,
@@ -177,8 +192,25 @@ def _run(args: argparse.Namespace) -> int:
         import jax
 
         profile_ctx = jax.profiler.trace(config.profile_dir)
-    with profile_ctx:
-        return _dispatch(args, config)
+
+    from sieve import metrics, trace
+
+    file_sink = None
+    if config.metrics_file:
+        file_sink = metrics.FileSink(config.metrics_file)
+        metrics.add_sink(file_sink)
+    if config.trace_file:
+        trace.enable()
+    try:
+        with profile_ctx:
+            return _dispatch(args, config)
+    finally:
+        if config.trace_file:
+            trace.disable()
+            trace.save(config.trace_file)
+        if file_sink is not None:
+            metrics.remove_sink(file_sink)
+            file_sink.close()
 
 
 def _dispatch(args: argparse.Namespace, config: SieveConfig) -> int:

@@ -36,16 +36,18 @@ import time
 
 import numpy as np
 
+from sieve import trace
 from sieve.checkpoint import Ledger
 from sieve.config import SieveConfig
 from sieve.coordinator import SieveResult, merge_results
-from sieve.metrics import MetricsLogger
+from sieve.metrics import MetricsLogger, registry
 from sieve.seed import seed_primes
 from sieve.segments import plan_segments, validate_plan
 from sieve.worker import SegmentResult
 
 HEARTBEAT_S = 1.0
 DEADLINE_S = float(os.environ.get("SIEVE_CLUSTER_DEADLINE_S", "60"))
+ANY_WORKER = -1  # chaos_kill "any@s": whichever worker draws segment s
 
 
 # --- framing -----------------------------------------------------------------
@@ -119,9 +121,14 @@ def serve_worker(config: SieveConfig, worker_id: int | None = None) -> None:
                 try:
                     if os.environ.get("SIEVE_CHAOS_RAISE") == str(m["seg_id"]):
                         raise RuntimeError("chaos: injected segment failure")
-                    result.append(
-                        worker.process_segment(m["lo"], m["hi"], seeds, m["seg_id"])
-                    )
+                    with trace.span(
+                        "worker.segment", seg=m["seg_id"], worker=worker_id
+                    ):
+                        result.append(
+                            worker.process_segment(
+                                m["lo"], m["hi"], seeds, m["seg_id"]
+                            )
+                        )
                 except Exception as e:  # report, don't die: the coordinator
                     import traceback     # decides whether to retry or abort
 
@@ -201,7 +208,10 @@ class _WorkerConn(threading.Thread):
                 if seg.seg_id in cl.done:
                     continue
                 current = (seg.seg_id, seg.lo, seg.hi)
-                chaos = cl.chaos == (self.worker_id, seg.seg_id)
+                chaos = cl.chaos is not None and cl.chaos[1] == seg.seg_id \
+                    and cl.chaos[0] in (ANY_WORKER, self.worker_id)
+                reg = registry()
+                t_assign = time.perf_counter()
                 send_msg(
                     self.sock,
                     {
@@ -214,10 +224,42 @@ class _WorkerConn(threading.Thread):
                 )
                 while True:
                     msg = recv_msg(self.sock)
+                    inflight = time.perf_counter() - t_assign
                     if msg is None:
                         raise ConnectionError("worker closed mid-assignment")
                     if msg["type"] == "progress":
-                        continue  # deadline refreshed by settimeout per recv
+                        # deadline refreshed by settimeout per recv; the
+                        # heartbeat also feeds the straggler watermark:
+                        # the longest any in-flight assignment has run
+                        reg.counter("cluster.heartbeats").inc()
+                        reg.gauge(
+                            f"cluster.worker{self.worker_id}.inflight_s"
+                        ).set(round(inflight, 4))
+                        reg.gauge("cluster.straggler_s").max(
+                            round(inflight, 4)
+                        )
+                        trace.instant(
+                            "cluster.heartbeat",
+                            worker=self.worker_id,
+                            seg=seg.seg_id,
+                        )
+                        continue
+                    if msg["type"] in ("done", "error"):
+                        # one RPC round-trip: assign -> terminal reply
+                        trace.add_span(
+                            "rpc.assign",
+                            t_assign,
+                            inflight,
+                            worker=self.worker_id,
+                            seg=seg.seg_id,
+                            outcome=msg["type"],
+                        )
+                        reg.histogram("cluster.rpc_ms").observe(
+                            inflight * 1000
+                        )
+                        reg.gauge(
+                            f"cluster.worker{self.worker_id}.inflight_s"
+                        ).set(0.0)
                     if msg["type"] == "done":
                         cl.complete(SegmentResult.from_dict(msg["result"]))
                         current = None
@@ -253,7 +295,9 @@ class _Cluster:
         self.chaos: tuple[int, int] | None = None
         if config.chaos_kill:
             k, s = config.chaos_kill.split("@")
-            self.chaos = (int(k), int(s))
+            # "any@s": kill whichever worker draws segment s — the pull
+            # model makes "k@s" probabilistic, "any@s" deterministic
+            self.chaos = (ANY_WORKER if k in ("any", "*") else int(k), int(s))
         for seg in segments:
             self.queue.put(seg)
 
@@ -271,12 +315,14 @@ class _Cluster:
     MAX_ATTEMPTS = 4
 
     def worker_failed(self, worker_id, current, reason: str) -> None:
+        registry().counter("cluster.worker_failures").inc()
         self.metrics.event("worker_failed", worker=worker_id, reason=reason)
         self._requeue(current, reason)
 
     def segment_error(self, current, reason: str) -> None:
         """A worker survived but its segment raised: retry elsewhere, abort
         the run if the failure looks deterministic (MAX_ATTEMPTS strikes)."""
+        registry().counter("cluster.segment_errors").inc()
         self.metrics.event("segment_error", reason=reason.splitlines()[0])
         self._requeue(current, reason)
 
@@ -297,6 +343,7 @@ class _Cluster:
                 return
         from sieve.segments import Segment
 
+        registry().counter("cluster.reassigned").inc()
         self.metrics.event("reassign", seg_id=seg_id)
         # one-shot chaos: don't re-kill the replacement owner
         if self.chaos and self.chaos[1] == seg_id:
@@ -311,7 +358,8 @@ def run_cluster(config: SieveConfig) -> SieveResult:
     cfg = config
     t0 = time.perf_counter()
     metrics = MetricsLogger(cfg)
-    seeds = seed_primes(cfg.seed_limit)
+    with trace.span("run.seed", backend=cfg.backend):
+        seeds = seed_primes(cfg.seed_limit)
     n_segments = cfg.resolved_n_segments()
     if cfg.n_segments is None and cfg.segment_values is None:
         n_segments = max(cfg.workers * 4, 16)  # sensible default for pull model
@@ -402,7 +450,8 @@ def run_cluster(config: SieveConfig) -> SieveResult:
     if cluster.fatal:
         raise RuntimeError(f"cluster run aborted: {cluster.fatal}")
     results = [cluster.done[s.seg_id] for s in segs]
-    pi, twins = merge_results(eff, results)
+    with trace.span("run.merge"):
+        pi, twins = merge_results(eff, results)
     elapsed = time.perf_counter() - t0
     result = SieveResult(
         n=eff.n,

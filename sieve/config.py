@@ -55,8 +55,13 @@ class SieveConfig:
     # calls jax.distributed.initialize() before touching devices; workers
     # must equal the GLOBAL device count.
     multihost: bool = False
-    # Observability.
+    # Observability. ``trace_file`` writes a Chrome trace-event JSON of
+    # host-side spans (sieve/trace.py); ``metrics_file`` appends every
+    # metrics event as JSONL regardless of --quiet. Neither affects the
+    # math (both are excluded from config_hash like the rest).
     profile_dir: str | None = None
+    trace_file: str | None = None
+    metrics_file: str | None = None
     quiet: bool = False
     json_output: bool = False
     # Fault injection hook "--chaos-kill-worker k@segment s" (section 5.3).

@@ -17,6 +17,7 @@ from typing import Callable, Iterable
 
 import numpy as np
 
+from sieve import trace
 from sieve.bitset import get_layout
 from sieve.checkpoint import Ledger
 from sieve.config import SieveConfig
@@ -112,8 +113,10 @@ class Coordinator:
     def run(self) -> SieveResult:
         cfg = self.config
         t0 = time.perf_counter()
-        seeds = seed_primes(cfg.seed_limit)
-        segs = self.plan()
+        with trace.span("run.seed", backend=cfg.backend):
+            seeds = seed_primes(cfg.seed_limit)
+        with trace.span("run.plan"):
+            segs = self.plan()
 
         ledger = Ledger.open(cfg) if cfg.checkpoint_dir else None
         done: dict[int, SegmentResult] = {}
@@ -126,7 +129,12 @@ class Coordinator:
             for seg in segs:
                 if seg.seg_id in done:
                     continue
-                res = worker.process_segment(seg.lo, seg.hi, seeds, seg.seg_id)
+                with trace.span(
+                    "segment.process", seg=seg.seg_id, backend=cfg.backend
+                ):
+                    res = worker.process_segment(
+                        seg.lo, seg.hi, seeds, seg.seg_id
+                    )
                 done[seg.seg_id] = res
                 if ledger is not None:
                     ledger.record(res)
@@ -135,7 +143,8 @@ class Coordinator:
             worker.close()
 
         results = [done[s.seg_id] for s in segs]
-        pi, twins = merge_results(cfg, results)
+        with trace.span("run.merge"):
+            pi, twins = merge_results(cfg, results)
         elapsed = time.perf_counter() - t0
         phases = getattr(worker, "phase_seconds", None) or None
         host_phases = (

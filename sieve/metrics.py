@@ -1,34 +1,281 @@
-"""Metrics / structured logging (SURVEY.md section 5.5).
+"""Metrics: a registry of counters/gauges/histograms + pluggable sinks.
 
-One JSON line per segment (id, owner, lo, hi, ms, count) plus an end-of-run
-summary carrying the north-star metric, primes/sec/chip. ``--quiet``
-suppresses per-segment lines; ``--json`` makes the final result a single
-machine-readable line.
+Two layers (SURVEY.md section 5.5, reworked):
+
+* :class:`MetricsRegistry` — named instruments (:class:`Counter`,
+  :class:`Gauge`, :class:`Histogram`) updated from anywhere in the
+  stack (cluster heartbeats, straggler watches, segment timings).
+  ``registry().snapshot()`` returns plain JSON-able values.
+* Event sinks — every structured event record (one JSON object per
+  event) is fanned out to the emitting logger's own stream (stderr by
+  default, as before) **and** to every globally registered sink:
+  ``--metrics-file`` installs a :class:`FileSink` (JSONL), tests
+  install a :class:`MemorySink`.
+
+Event schema: every record carries ``event`` (the kind) and ``ts``
+(seconds since the process trace epoch — ``time.perf_counter`` based,
+monotonic, directly comparable with span times in a ``--trace`` file).
+Required per-kind keys are documented in :data:`EVENT_SCHEMA` and
+enforced by tests through the in-memory sink.
+
+``--quiet`` drops only the per-segment console lines; the run summary
+and robustness events (``worker_failed``, ``segment_error``,
+``reassign``, ``resume``) always reach the console stream, and global
+sinks receive *every* record regardless of quiet.
 """
 
 from __future__ import annotations
 
 import json
 import sys
-import time
+import threading
 from typing import TYPE_CHECKING, Any, TextIO
+
+from sieve import trace
 
 if TYPE_CHECKING:
     from sieve.config import SieveConfig
     from sieve.coordinator import SieveResult
     from sieve.worker import SegmentResult
 
+# Required keys per event kind ("event" and "ts" are implicit on every
+# record). Kinds may carry extra keys; these are the stable contract.
+EVENT_SCHEMA: dict[str, set[str]] = {
+    "segment": {"id", "lo", "hi", "ms", "count"},
+    "run": {"n", "pi", "backend", "packing", "elapsed_s", "values_per_sec"},
+    "resume": {"restored"},
+    "worker_failed": {"worker", "reason"},
+    "segment_error": {"reason"},
+    "reassign": {"seg_id"},
+    "host_prepare": {"prep_s"},
+}
+
+
+def validate_record(record: dict[str, Any]) -> None:
+    """Raise ValueError if a record violates the documented schema."""
+    kind = record.get("event")
+    if not isinstance(kind, str):
+        raise ValueError(f"record missing 'event' kind: {record!r}")
+    if "ts" not in record:
+        raise ValueError(f"record missing 'ts': {record!r}")
+    required = EVENT_SCHEMA.get(kind, set())
+    missing = required - record.keys()
+    if missing:
+        raise ValueError(f"{kind!r} record missing keys {sorted(missing)}")
+    json.dumps(record)  # every value must be JSON-serializable
+
+
+# --- instruments -------------------------------------------------------------
+
+
+class Counter:
+    """Monotonically increasing count."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+        trace.counter(self.name, self.value)
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    """Last-observed value (heartbeat age, straggler lag, queue depth)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: float | None = None
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = v
+        trace.counter(self.name, v)
+
+    def max(self, v: float) -> None:
+        """Keep the running maximum (straggler watermarks)."""
+        with self._lock:
+            if self.value is None or v > self.value:
+                self.value = v
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"type": "gauge", "value": self.value}
+
+
+class Histogram:
+    """Streaming summary: count/sum/min/max (no buckets — the sieve's
+    distributions are summarized, full timelines belong in ``--trace``)."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "type": "histogram",
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": self.sum / self.count if self.count else None,
+        }
+
+
+class MetricsRegistry:
+    """Named instruments; one process-wide instance by default."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: dict[str, Any] = {}
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name)
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}"
+                )
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: v.snapshot() for k, v in self._instruments.items()}
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+# --- sinks -------------------------------------------------------------------
+
+
+class MemorySink:
+    """Collects records in memory — the test/inspection sink."""
+
+    def __init__(self) -> None:
+        self.records: list[dict] = []
+        self._lock = threading.Lock()
+
+    def emit(self, record: dict) -> None:
+        with self._lock:
+            self.records.append(record)
+
+    def close(self) -> None:
+        pass
+
+
+class StreamSink:
+    """JSONL onto an open text stream."""
+
+    def __init__(self, stream: TextIO):
+        self.stream = stream
+        self._lock = threading.Lock()
+
+    def emit(self, record: dict) -> None:
+        with self._lock:
+            self.stream.write(json.dumps(record) + "\n")
+            self.stream.flush()
+
+    def close(self) -> None:
+        pass
+
+
+class FileSink(StreamSink):
+    """JSONL appended to a file (``--metrics-file``)."""
+
+    def __init__(self, path: str):
+        super().__init__(open(path, "a"))
+
+    def close(self) -> None:
+        self.stream.close()
+
+
+_SINKS: list = []
+_SINKS_LOCK = threading.Lock()
+
+
+def add_sink(sink) -> None:
+    """Register a global sink; every MetricsLogger fans records into it."""
+    with _SINKS_LOCK:
+        _SINKS.append(sink)
+
+
+def remove_sink(sink) -> None:
+    with _SINKS_LOCK:
+        if sink in _SINKS:
+            _SINKS.remove(sink)
+
+
+def _global_sinks() -> list:
+    with _SINKS_LOCK:
+        return list(_SINKS)
+
+
+# --- the event logger --------------------------------------------------------
+
 
 class MetricsLogger:
+    """Structured event emitter for one run.
+
+    Console behavior matches the original module: one JSON line per
+    segment plus an end-of-run summary on stderr. ``--quiet`` now only
+    suppresses the per-segment console lines — the summary and
+    robustness events always print, and global sinks always get
+    everything.
+    """
+
     def __init__(self, config: "SieveConfig", stream: TextIO | None = None):
         self.config = config
         self.stream = stream if stream is not None else sys.stderr
-        self.t_start = time.time()
+        self.t_start = trace.now_s()
 
-    def _emit(self, record: dict[str, Any]) -> None:
-        if self.config.quiet:
+    def _emit(self, record: dict[str, Any], per_segment: bool = False) -> None:
+        # monotonic, trace-epoch-relative: comparable with span times
+        record.setdefault("ts", round(trace.now_s(), 4))
+        for sink in _global_sinks():
+            sink.emit(record)
+        if per_segment and self.config.quiet:
             return
-        record.setdefault("ts", round(time.time() - self.t_start, 4))
         self.stream.write(json.dumps(record) + "\n")
         self.stream.flush()
 
@@ -36,6 +283,9 @@ class MetricsLogger:
         self._emit({"event": kind, **fields})
 
     def segment(self, res: "SegmentResult") -> None:
+        reg = registry()
+        reg.counter("segments_done").inc()
+        reg.histogram("segment_ms").observe(res.elapsed_s * 1000)
         self._emit(
             {
                 "event": "segment",
@@ -44,7 +294,8 @@ class MetricsLogger:
                 "hi": res.hi,
                 "ms": round(res.elapsed_s * 1000, 3),
                 "count": res.count,
-            }
+            },
+            per_segment=True,
         )
 
     def run_summary(self, result: "SieveResult") -> None:

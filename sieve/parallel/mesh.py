@@ -30,6 +30,7 @@ import time
 
 import numpy as np
 
+from sieve import trace
 from sieve.backends.jax_backend import pair_kind
 from sieve.bitset import get_layout
 from sieve.checkpoint import Ledger
@@ -304,6 +305,10 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
     cfg = config
     metrics = MetricsLogger(cfg)
     t0 = time.perf_counter()
+    # host_phases is span-derived: snapshot the process-wide tracer so
+    # this run's phase totals are the delta (pipeline producer threads
+    # start emitting prep.round spans as soon as the pipeline exists)
+    tsnap = trace.snapshot()
     ndev = cfg.workers
     if mesh is None:
         mesh = build_mesh(ndev)
@@ -341,7 +346,8 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
     # is refused by the config-hash guard rather than mis-merged
     cfg = SieveConfig(**{**cfg.to_dict(), "n_segments": n_segs})
 
-    seeds = seed_primes(cfg.seed_limit)
+    with trace.span("run.seed", backend=cfg.backend):
+        seeds = seed_primes(cfg.seed_limit)
     twin_kind = pair_kind(cfg)
     pgap = getattr(cfg, "pair_gap", 2) or 2
     # Shared shapes are derived from the segment plan and the chain's
@@ -438,7 +444,8 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
 
     def _drain_one():
         batch, nbits_b, out, rt0 = pending.pop(0)
-        vals = np.asarray(out).astype(np.int64)  # single uint32 fetch
+        with trace.span("round.drain", round=batch[0].seg_id // ndev):
+            vals = np.asarray(out).astype(np.int64)  # single uint32 fetch
         total = int(vals[0])
         total_twins = int(vals[1])
         counts = vals[2 : 2 + ndev]
@@ -510,8 +517,6 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
         ],
         window,
     )
-    host_t = {"prep_wait_s": 0.0, "stack_s": 0.0, "device_idle_s": 0.0}
-
     try:
         for rnd in todo:
             batch = segs[rnd * ndev : (rnd + 1) * ndev]
@@ -521,7 +526,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
             device_starved = not pending
             preps = pipeline.take(rnd)
             t_prep = time.perf_counter()
-            host_t["prep_wait_s"] += t_prep - rt0
+            trace.add_span("round.prep_wait", rt0, t_prep - rt0, round=rnd)
             nbits_v = np.array([p.nbits for p in preps], np.int32)
             # gap_ok[d] = 1 iff (last candidate of seg d, first of seg d+1)
             # is a potential twin pair (values differ by 2) — odds
@@ -604,8 +609,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                         np.stack([p.flat_mask for p in preps]),
                         gap_ok,
                     )
-                t_stack = time.perf_counter()
-                out = rstep(*args)
+                dispatch_step = rstep
             else:
                 patterns = tuple(
                     np.stack([p.patterns[i] for p in preps])
@@ -625,16 +629,20 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                     nbits_v, patterns, m2, r2, K2, rcp2, act2, ci, cm,
                     pmask, gap_ok,
                 )
-                t_stack = time.perf_counter()
-                out = step(*args)
-            host_t["stack_s"] += t_stack - t_prep
+                dispatch_step = step
+            t_stack = time.perf_counter()
+            trace.add_span("round.stack", t_prep, t_stack - t_prep, round=rnd)
             if device_starved:
                 # prep-wait + stacking with an empty device queue is true
                 # device idle; the dispatch call itself (which includes
                 # trace/compile on first use of a shape bucket) is not
                 # counted — compile cost is amortized and not a
                 # prepare-pipeline property
-                host_t["device_idle_s"] += t_stack - rt0
+                trace.add_span(
+                    "round.device_idle", rt0, t_stack - rt0, round=rnd
+                )
+            with trace.span("round.dispatch", round=rnd):
+                out = dispatch_step(*args)
             pending.append((batch, nbits_v, out, rt0))
             while len(pending) > window:
                 _drain_one()
@@ -645,23 +653,37 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
         pipeline.close()
 
     results = [done[s.seg_id] for s in segs]
-    pi, twin_pairs = merge_results(cfg, results)
+    with trace.span("run.merge"):
+        pi, twin_pairs = merge_results(cfg, results)
     elapsed = time.perf_counter() - t0
 
     chain_phases: dict[str, float] = {}
     for st in pipeline.states:
         for k, v in getattr(st, "phase_seconds", {}).items():
             chain_phases[k] = chain_phases.get(k, 0.0) + v
-    prep_s = pipeline.stats["prep_seconds"]
+    # Every phase total below is the sum of this run's spans (delta vs
+    # the snapshot taken at entry) — the same numbers a --trace file
+    # shows, by construction. Keys are unchanged from the hand-rolled
+    # bookkeeping this replaces (BASELINE.md "host-prepare" section);
+    # dispatch_s/drain_s are new.
+    agg = trace.since(tsnap)
+
+    def _tot(name: str) -> float:
+        return agg.get(name, (0.0, 0))[0]
+
+    prep_s = _tot("prep.round")
+    device_idle_s = _tot("round.device_idle")
     values_prepared = sum(
         s.hi - s.lo for rnd in todo for s in segs[rnd * ndev : (rnd + 1) * ndev]
     )
-    idle_frac = host_t["device_idle_s"] / elapsed if elapsed > 0 else 0.0
+    idle_frac = device_idle_s / elapsed if elapsed > 0 else 0.0
     host_phases = {
         "prep_s": round(prep_s, 6),
-        "prep_wait_s": round(host_t["prep_wait_s"], 6),
-        "stack_s": round(host_t["stack_s"], 6),
-        "device_idle_s": round(host_t["device_idle_s"], 6),
+        "prep_wait_s": round(_tot("round.prep_wait"), 6),
+        "stack_s": round(_tot("round.stack"), 6),
+        "dispatch_s": round(_tot("round.dispatch"), 6),
+        "drain_s": round(_tot("round.drain"), 6),
+        "device_idle_s": round(device_idle_s, 6),
         "device_idle_frac": round(idle_frac, 6),
         "overlap_efficiency": round(1.0 - idle_frac, 6),
         "rounds_prepared": pipeline.stats["rounds_prepared"],

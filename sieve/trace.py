@@ -1,0 +1,294 @@
+"""Span-based tracing: every phase of the sieve, visible.
+
+One process-wide :class:`Tracer` times named spans on any thread::
+
+    from sieve import trace
+    with trace.span("round.prep_wait", round=k):
+        preps = pipeline.take(k)
+
+Two cost tiers, by design:
+
+* **Aggregation is always on.** Every span's duration folds into a
+  ``name -> (total_seconds, count)`` table under a lock — a pair of
+  ``perf_counter`` calls and a dict update, well under 2 us per span.
+  This is what lets ``run_mesh`` derive ``host_phases`` from spans
+  instead of hand-rolled bookkeeping, with or without ``--trace``.
+* **Event capture is opt-in** (``trace.enable()`` / ``--trace FILE``).
+  Only then does each span also append a Chrome trace-event record
+  (complete "X" event with microsecond ``ts``/``dur``, real ``tid`` so
+  pipeline producer threads and the mesh loop land on separate tracks).
+  ``trace.save(path)`` writes ``{"traceEvents": [...]}`` — loadable in
+  Perfetto / ``chrome://tracing`` directly.
+
+All timestamps come from ``time.perf_counter()`` relative to one
+process-wide epoch, so span times, instant events, counter samples, and
+MetricsLogger ``ts`` fields are mutually comparable (no wall-clock /
+monotonic mixing).
+
+Per-run accounting over the process-wide tracer uses snapshot diffs::
+
+    snap = trace.snapshot()
+    ...           # run spans on any number of threads
+    agg = trace.since(snap)   # {name: (delta_seconds, delta_count)}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, TextIO
+
+# One monotonic epoch for the whole process: spans, counters, instants
+# and metrics timestamps all subtract this, so they share one timeline.
+_EPOCH = time.perf_counter()
+
+
+def now_s() -> float:
+    """Seconds since the process trace epoch (monotonic)."""
+    return time.perf_counter() - _EPOCH
+
+
+class Span:
+    """Context manager for one timed span.
+
+    ``elapsed`` (seconds) is valid after ``__exit__`` so callers that
+    also need the measurement (e.g. per-mode device timers) read it
+    from the span instead of timing twice.
+    """
+
+    __slots__ = ("_tracer", "name", "args", "t0", "elapsed")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict | None):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "Span":
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = time.perf_counter()
+        self.elapsed = t1 - self.t0
+        self._tracer._record(self.name, self.t0, t1, self.args)
+        return False
+
+
+class Tracer:
+    """Thread-safe span tracer with always-on aggregation and optional
+    Chrome trace-event capture."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.enabled = False
+        self._events: list[dict] = []
+        self._totals: dict[str, list] = {}  # name -> [total_s, count]
+        self._tids_named: set[int] = set()
+
+    # --- recording -----------------------------------------------------------
+
+    def span(self, name: str, **args: Any) -> Span:
+        return Span(self, name, args or None)
+
+    def _record(
+        self, name: str, t0: float, t1: float, args: dict | None
+    ) -> None:
+        with self._lock:
+            tot = self._totals.get(name)
+            if tot is None:
+                tot = self._totals[name] = [0.0, 0]
+            tot[0] += t1 - t0
+            tot[1] += 1
+            if self.enabled:
+                self._append_event(name, t0, t1, args)
+
+    def add_span(
+        self, name: str, t0: float, duration_s: float, **args: Any
+    ) -> None:
+        """Record an already-measured interval (``t0`` is a raw
+        ``perf_counter`` value) — for synthetic spans like device-idle
+        windows whose bounds were observed rather than entered/exited."""
+        self._record(name, t0, t0 + duration_s, args or None)
+
+    def instant(self, name: str, **args: Any) -> None:
+        """Zero-duration marker (heartbeats, resume points)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if not self.enabled:
+                return
+            self._events.append(
+                {
+                    "name": name,
+                    "ph": "i",
+                    "s": "t",
+                    "ts": round((time.perf_counter() - _EPOCH) * 1e6, 3),
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident(),
+                    **({"args": args} if args else {}),
+                }
+            )
+
+    def counter(self, name: str, value: float) -> None:
+        """Sample a counter/gauge value onto the trace timeline."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if not self.enabled:
+                return
+            self._events.append(
+                {
+                    "name": name,
+                    "ph": "C",
+                    "ts": round((time.perf_counter() - _EPOCH) * 1e6, 3),
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident(),
+                    "args": {"value": value},
+                }
+            )
+
+    def _append_event(
+        self, name: str, t0: float, t1: float, args: dict | None
+    ) -> None:
+        # caller holds the lock
+        tid = threading.get_ident()
+        if tid not in self._tids_named:
+            self._tids_named.add(tid)
+            self._events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": os.getpid(),
+                    "tid": tid,
+                    "args": {"name": threading.current_thread().name},
+                }
+            )
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": round((t0 - _EPOCH) * 1e6, 3),
+            "dur": round((t1 - t0) * 1e6, 3),
+            "pid": os.getpid(),
+            "tid": tid,
+        }
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+
+    # --- control / export ----------------------------------------------------
+
+    def enable(self, clear: bool = True) -> None:
+        """Start capturing events. By default the event buffer is
+        cleared so each capture session (one ``--trace`` run) stands
+        alone; aggregation totals are never cleared here."""
+        with self._lock:
+            if clear:
+                self._events.clear()
+                self._tids_named.clear()
+            self.enabled = True
+
+    def disable(self) -> None:
+        with self._lock:
+            self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._totals.clear()
+            self._tids_named.clear()
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def save(self, path_or_file: str | TextIO) -> None:
+        """Write the captured events as Chrome trace-event JSON."""
+        doc = {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+        if hasattr(path_or_file, "write"):
+            json.dump(doc, path_or_file)
+        else:
+            with open(path_or_file, "w") as f:
+                json.dump(doc, f)
+
+    # --- aggregation ---------------------------------------------------------
+
+    def snapshot(self) -> dict[str, tuple[float, int]]:
+        """Copy of the (total_seconds, count) aggregate per span name."""
+        with self._lock:
+            return {k: (v[0], v[1]) for k, v in self._totals.items()}
+
+    def since(
+        self, snap: dict[str, tuple[float, int]]
+    ) -> dict[str, tuple[float, int]]:
+        """Aggregate delta since a :meth:`snapshot` (per-run accounting
+        over the process-wide tracer)."""
+        out: dict[str, tuple[float, int]] = {}
+        for name, (tot, cnt) in self.snapshot().items():
+            b_tot, b_cnt = snap.get(name, (0.0, 0))
+            if cnt > b_cnt:
+                out[name] = (tot - b_tot, cnt - b_cnt)
+        return out
+
+    def total_s(
+        self, name: str, snap: dict[str, tuple[float, int]] | None = None
+    ) -> float:
+        agg = self.since(snap) if snap is not None else self.snapshot()
+        return agg.get(name, (0.0, 0))[0]
+
+
+# Process-wide tracer and module-level conveniences (the instrumented
+# call sites all go through these).
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def span(name: str, **args: Any) -> Span:
+    return _TRACER.span(name, **args)
+
+
+def add_span(name: str, t0: float, duration_s: float, **args: Any) -> None:
+    _TRACER.add_span(name, t0, duration_s, **args)
+
+
+def instant(name: str, **args: Any) -> None:
+    _TRACER.instant(name, **args)
+
+
+def counter(name: str, value: float) -> None:
+    _TRACER.counter(name, value)
+
+
+def enable() -> None:
+    _TRACER.enable()
+
+
+def disable() -> None:
+    _TRACER.disable()
+
+
+def enabled() -> bool:
+    return _TRACER.enabled
+
+
+def save(path_or_file: str | TextIO) -> None:
+    _TRACER.save(path_or_file)
+
+
+def snapshot() -> dict[str, tuple[float, int]]:
+    return _TRACER.snapshot()
+
+
+def since(snap: dict[str, tuple[float, int]]) -> dict[str, tuple[float, int]]:
+    return _TRACER.since(snap)
+
+
+def total_s(
+    name: str, snap: dict[str, tuple[float, int]] | None = None
+) -> float:
+    return _TRACER.total_s(name, snap)

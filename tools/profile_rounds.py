@@ -1,5 +1,10 @@
 """Time the mesh round path (workers=1) on the real chip: warm, then
-measure. Usage: python tools/profile_rounds.py [n] [rounds] [--twins]"""
+measure. A thin wrapper over the span tracer — the warm run is traced
+and summarized with tools/trace_report.py (pass --trace-out FILE to
+also keep the Perfetto-loadable file).
+
+Usage: python tools/profile_rounds.py [n] [rounds] [--twins]
+           [--trace-out FILE]"""
 
 from __future__ import annotations
 
@@ -17,26 +22,48 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    argv = sys.argv[1:]
+    trace_out = None
+    if "--trace-out" in argv:
+        i = argv.index("--trace-out")
+        trace_out = argv[i + 1]
+        del argv[i : i + 2]
+    args = [a for a in argv if not a.startswith("--")]
     n = int(float(args[0])) if args else 10**10
     rounds = int(args[1]) if len(args) > 1 else 8
-    twins = "--twins" in sys.argv
+    twins = "--twins" in argv
 
     from sieve.config import SieveConfig
     from sieve.parallel.mesh import run_mesh
+
+    from sieve import trace
+    from tools.trace_report import load_events, report
 
     cfg = SieveConfig(n=n, backend="tpu-pallas", packing="odds", workers=1,
                       rounds=rounds, twins=twins, quiet=True)
     t0 = time.perf_counter()
     res = run_mesh(cfg)
     cold = time.perf_counter() - t0
+    trace.enable()  # capture spans for the warm (steady-state) run only
     t0 = time.perf_counter()
     res = run_mesh(cfg)
     warm = time.perf_counter() - t0
+    trace.disable()
     print(f"n={n:.0e} rounds={rounds} twins={twins} pi={res.pi} "
           f"twin={res.twin_pairs}")
     print(f"cold={cold:.2f}s warm={warm:.2f}s "
           f"({(n - 1) / warm:.3e} values/s warm)")
+
+    if trace_out is not None:
+        trace.save(trace_out)
+        print(f"trace written to {trace_out}")
+    import io
+
+    buf = io.StringIO()
+    trace.save(buf)
+    buf.seek(0)
+    print()
+    print(report(load_events(buf)))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,139 @@
+"""Render a ``--trace`` file on the terminal: per-phase breakdown,
+device-idle timeline, and the slowest spans.
+
+The input is Chrome trace-event JSON as written by sieve/trace.py
+(``{"traceEvents": [...]}``; a bare event array is accepted too), so the
+same file loads in Perfetto / ``chrome://tracing`` for the visual view.
+
+Usage: python tools/trace_report.py TRACE_FILE [--top N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_events(path_or_file) -> list[dict]:
+    """Complete ("X") span events from a trace file, sorted by start."""
+    if hasattr(path_or_file, "read"):
+        doc = json.load(path_or_file)
+    else:
+        with open(path_or_file) as f:
+            doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    spans = [e for e in events if e.get("ph") == "X"]
+    spans.sort(key=lambda e: e["ts"])
+    return spans
+
+
+def phase_breakdown(spans: list[dict]) -> dict[str, dict]:
+    """Aggregate spans by name: count, total/mean/max duration (us)."""
+    agg: dict[str, dict] = {}
+    for e in spans:
+        a = agg.setdefault(
+            e["name"], {"count": 0, "total_us": 0.0, "max_us": 0.0}
+        )
+        a["count"] += 1
+        a["total_us"] += e["dur"]
+        if e["dur"] > a["max_us"]:
+            a["max_us"] = e["dur"]
+    for a in agg.values():
+        a["mean_us"] = a["total_us"] / a["count"]
+    return agg
+
+
+def wall_span_us(spans: list[dict]) -> float:
+    if not spans:
+        return 0.0
+    lo = min(e["ts"] for e in spans)
+    hi = max(e["ts"] + e["dur"] for e in spans)
+    return hi - lo
+
+
+def _fmt_args(e: dict) -> str:
+    args = e.get("args")
+    if not args:
+        return ""
+    return " " + " ".join(f"{k}={v}" for k, v in sorted(args.items()))
+
+
+def report(spans: list[dict], top: int = 10) -> str:
+    """The full text report (kept a pure function so tests and the
+    profile_* wrappers can render without going through the CLI)."""
+    lines: list[str] = []
+    wall = wall_span_us(spans)
+    lines.append(
+        f"{len(spans)} spans over {wall / 1e3:.1f} ms of host timeline"
+    )
+
+    lines.append("")
+    lines.append("per-phase breakdown (by total time):")
+    lines.append(
+        f"  {'phase':<24} {'count':>6} {'total ms':>10} {'mean ms':>9} "
+        f"{'max ms':>9} {'% wall':>7}"
+    )
+    agg = phase_breakdown(spans)
+    for name, a in sorted(
+        agg.items(), key=lambda kv: -kv[1]["total_us"]
+    ):
+        pct = 100.0 * a["total_us"] / wall if wall else 0.0
+        lines.append(
+            f"  {name:<24} {a['count']:>6} {a['total_us'] / 1e3:>10.3f} "
+            f"{a['mean_us'] / 1e3:>9.3f} {a['max_us'] / 1e3:>9.3f} "
+            f"{pct:>6.1f}%"
+        )
+
+    lines.append("")
+    idle = [e for e in spans if e["name"] == "round.device_idle"]
+    if idle:
+        total_idle = sum(e["dur"] for e in idle)
+        frac = total_idle / wall if wall else 0.0
+        lines.append(
+            f"device-idle timeline ({len(idle)} windows, "
+            f"{total_idle / 1e3:.3f} ms, {100 * frac:.1f}% of timeline):"
+        )
+        t0 = min(e["ts"] for e in spans)
+        for e in idle:
+            lines.append(
+                f"  +{(e['ts'] - t0) / 1e3:>10.3f} ms  "
+                f"idle {e['dur'] / 1e3:>8.3f} ms{_fmt_args(e)}"
+            )
+    else:
+        lines.append(
+            "device-idle timeline: no round.device_idle spans "
+            "(device never starved, or not a mesh run)"
+        )
+
+    lines.append("")
+    lines.append(f"slowest {min(top, len(spans))} spans:")
+    for e in sorted(spans, key=lambda e: -e["dur"])[:top]:
+        lines.append(
+            f"  {e['dur'] / 1e3:>10.3f} ms  {e['name']}{_fmt_args(e)}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="summarize a sieve --trace file (Chrome trace-event "
+        "JSON) as per-phase totals, device-idle windows, and slowest spans"
+    )
+    p.add_argument("trace_file")
+    p.add_argument("--top", type=int, default=10,
+                   help="how many slowest spans to list")
+    args = p.parse_args(argv)
+    spans = load_events(args.trace_file)
+    if not spans:
+        print("no span events in trace", file=sys.stderr)
+        return 1
+    print(report(spans, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
