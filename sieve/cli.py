@@ -69,7 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write host-side spans (round phases, prepare "
                         "threads, per-segment timings) as Chrome "
                         "trace-event JSON — open in Perfetto or "
-                        "chrome://tracing; see tools/trace_report.py")
+                        "chrome://tracing; on the cpu-cluster backend the "
+                        "file is the MERGED cluster timeline (coordinator "
+                        "+ one rebased track per worker); see "
+                        "tools/trace_report.py [--cluster]")
     p.add_argument("--metrics-file", default=None, dest="metrics_file",
                    metavar="FILE",
                    help="append every metrics event as JSONL (including "
@@ -224,6 +227,15 @@ def _dispatch(args: argparse.Namespace, config: SieveConfig) -> int:
         from sieve.cluster import run_cluster
 
         result = run_cluster(config)
+        dropped = (result.host_phases or {}).get("telemetry_dropped_events")
+        if dropped:
+            print(
+                f"sieve: warning: worker telemetry truncated ({dropped} "
+                "trace events dropped by the ship ring); the merged "
+                "--trace timeline is incomplete — raise "
+                "SIEVE_TELEMETRY_RING to keep more events per worker",
+                file=sys.stderr,
+            )
     elif config.backend in ("jax", "tpu-pallas") and (
         config.workers > 1 or config.rounds > 1
     ):

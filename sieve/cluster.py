@@ -11,11 +11,26 @@ double-processing after reassignment cannot double-count.
 
 Wire protocol: 8-byte big-endian length prefix + JSON. Messages:
   worker -> coordinator: {"type": "hello", "worker_id": i}
-                         {"type": "progress", "seg_id": s}
-                         {"type": "done", "result": SegmentResult dict}
+                         {"type": "progress", "seg_id", "t_recv", "t_hb"}
+                         {"type": "done", "result": SegmentResult dict,
+                          "ctx", "t_recv", "t_reply", "telemetry"}
   coordinator -> worker: {"type": "config", "config": .., "seeds": [..]}
-                         {"type": "assign", "seg_id", "lo", "hi", "chaos_die"}
+                         {"type": "assign", "seg_id", "lo", "hi",
+                          "chaos_die", "run_id", "ctx", "t_send"}
                          {"type": "shutdown"}
+
+Distributed trace plane: every ``assign`` carries a trace context
+(``run_id`` + per-attempt span id ``ctx``) that the worker attaches to
+its ``worker.recv``/``worker.segment``/``worker.reply`` spans, so each
+coordinator ``rpc.assign`` span correlates 1:1 with the worker-side
+spans of that attempt. Replies and heartbeats carry worker-clock
+timestamps; the coordinator keeps a min-RTT NTP-style sample per worker
+(offset error bounded by RTT/2) and, at end of run, rebases the shipped
+worker events onto its own trace epoch and merges them under per-worker
+Perfetto process tracks — one ``--trace`` file for the whole cluster.
+Telemetry rides the terminal ``done``/``error`` reply (bounded
+drop-oldest ring, see sieve/worker.py), so a worker that dies
+mid-assignment loses only its unshipped spans.
 
 Fault injection (section 5.3): ``--chaos-kill-worker k@s`` makes worker k
 hard-exit (os._exit) when it receives segment s — exercising detection,
@@ -32,7 +47,6 @@ import struct
 import subprocess
 import sys
 import threading
-import time
 
 import numpy as np
 
@@ -101,28 +115,44 @@ def serve_worker(config: SieveConfig, worker_id: int | None = None) -> None:
     seeds = np.asarray(msg["seeds"], dtype=np.int64)
 
     from sieve.backends import make_worker
+    from sieve.worker import telemetry_payload, telemetry_start
 
     compute_cfg = SieveConfig.from_dict(
         {**run_cfg.to_dict(), "backend": _worker_backend()}
     )
     worker = make_worker(compute_cfg)
+    shipping = telemetry_start()
+    reg = registry()
     try:
         while True:
+            t_wait0 = trace.now_s()
             msg = recv_msg(sock)
+            t_recv = trace.now_s()
             if msg is None or msg["type"] == "shutdown":
                 return
             assert msg["type"] == "assign", msg
             if msg.get("chaos_die"):
                 os._exit(17)  # simulated hard crash, no cleanup
+            ctx = msg.get("ctx")
+            # idle-wait + message receive: the worker-side view of "no
+            # work assigned" that per-host idle accounting needs
+            trace.add_span(
+                "worker.recv", t_wait0, t_recv - t_wait0,
+                seg=msg["seg_id"], worker=worker_id, ctx=ctx,
+            )
+            reg.histogram("worker.recv_wait_ms").observe(
+                round((t_recv - t_wait0) * 1000, 3)
+            )
             result: list[SegmentResult] = []
             failure: list[str] = []
 
-            def _work(m=msg):
+            def _work(m=msg, ctx=ctx):
                 try:
                     if os.environ.get("SIEVE_CHAOS_RAISE") == str(m["seg_id"]):
                         raise RuntimeError("chaos: injected segment failure")
                     with trace.span(
-                        "worker.segment", seg=m["seg_id"], worker=worker_id
+                        "worker.segment",
+                        seg=m["seg_id"], worker=worker_id, ctx=ctx,
                     ):
                         result.append(
                             worker.process_segment(
@@ -139,14 +169,40 @@ def serve_worker(config: SieveConfig, worker_id: int | None = None) -> None:
             while t.is_alive():
                 t.join(HEARTBEAT_S)
                 if t.is_alive():
-                    send_msg(sock, {"type": "progress", "seg_id": msg["seg_id"]})
+                    # t_recv/t_hb give the coordinator a payload-free NTP
+                    # sample mid-assignment (long segments refresh the
+                    # clock offset without waiting for the reply)
+                    send_msg(sock, {
+                        "type": "progress", "seg_id": msg["seg_id"],
+                        "t_recv": t_recv, "t_hb": trace.now_s(),
+                    })
             if failure:
-                send_msg(
-                    sock,
-                    {"type": "error", "seg_id": msg["seg_id"], "error": failure[0]},
-                )
+                reg.counter("worker.segment_errors").inc()
+                reply = {
+                    "type": "error", "seg_id": msg["seg_id"],
+                    "error": failure[0],
+                }
             else:
-                send_msg(sock, {"type": "done", "result": result[0].to_dict()})
+                res = result[0]
+                reg.counter("worker.segments_done").inc()
+                reg.histogram("worker.segment_ms").observe(
+                    round(res.elapsed_s * 1000, 3)
+                )
+                reply = {"type": "done", "result": res.to_dict()}
+            reply["ctx"] = ctx
+            reply["t_recv"] = t_recv
+            if shipping:
+                # piggyback: this drains worker.recv + worker.segment of
+                # THIS attempt (plus any earlier worker.reply) — a span
+                # that closes after the send ships on the next reply
+                reply["telemetry"] = telemetry_payload(worker_id)
+            t_reply = trace.now_s()
+            reply["t_reply"] = t_reply
+            send_msg(sock, reply)
+            trace.add_span(
+                "worker.reply", t_reply, trace.now_s() - t_reply,
+                seg=msg["seg_id"], worker=worker_id, ctx=ctx,
+            )
     finally:
         worker.close()
         sock.close()
@@ -169,6 +225,46 @@ def _worker_backend() -> str:
 # --- coordinator role --------------------------------------------------------
 
 
+class _ClockAlign:
+    """Per-worker clock-offset estimate from RPC timestamp pairs.
+
+    NTP-style: a pair (assign -> heartbeat/reply) gives
+    ``rtt = (t_done - t_send) - (t_remote_send - t_remote_recv)`` and
+    ``offset = ((t_remote_recv - t_send) + (t_remote_send - t_done)) / 2``
+    with ``worker_clock ≈ coordinator_clock + offset``. The estimate kept
+    is the one from the lowest-RTT sample seen so far (ties refresh to the
+    newest, so equal-quality samples track slow drift); its error is
+    bounded by RTT/2 plus any send/receive asymmetry.
+    """
+
+    __slots__ = ("offset_s", "rtt_s", "samples")
+
+    def __init__(self) -> None:
+        self.offset_s = 0.0
+        self.rtt_s = float("inf")
+        self.samples = 0
+
+    def sample(
+        self,
+        t_send: float,
+        t_remote_recv: float,
+        t_remote_send: float,
+        t_done: float,
+    ) -> None:
+        rtt = max(0.0, (t_done - t_send) - (t_remote_send - t_remote_recv))
+        self.samples += 1
+        if rtt <= self.rtt_s:
+            self.rtt_s = rtt
+            self.offset_s = (
+                (t_remote_recv - t_send) + (t_remote_send - t_done)
+            ) / 2
+
+    @property
+    def err_s(self) -> float:
+        """Alignment-error bound for the kept sample (RTT/2)."""
+        return self.rtt_s / 2 if self.samples else float("inf")
+
+
 class _WorkerConn(threading.Thread):
     """One coordinator-side thread per connected worker: assigns segments
     from the shared queue, enforces the progress deadline, requeues on
@@ -182,7 +278,9 @@ class _WorkerConn(threading.Thread):
 
     def run(self) -> None:
         cl = self.cluster
-        current: tuple[int, int, int] | None = None  # (seg_id, lo, hi)
+        # (seg_id, lo, hi, ctx): the in-flight assignment + its trace
+        # context, so failure events correlate with the timeline
+        current: tuple[int, int, int, str] | None = None
         try:
             hello = recv_msg(self.sock)
             if not hello or hello["type"] != "hello":
@@ -207,11 +305,16 @@ class _WorkerConn(threading.Thread):
                     continue
                 if seg.seg_id in cl.done:
                     continue
-                current = (seg.seg_id, seg.lo, seg.hi)
+                # per-attempt span id: rpc.assign here and worker.segment
+                # over there carry the same ctx, so the merged trace (and
+                # reassignments of the same segment) correlate exactly
+                attempt = cl.attempts.get(seg.seg_id, 0)
+                ctx = f"{cl.run_id}/{seg.seg_id}.{attempt}"
+                current = (seg.seg_id, seg.lo, seg.hi, ctx)
                 chaos = cl.chaos is not None and cl.chaos[1] == seg.seg_id \
                     and cl.chaos[0] in (ANY_WORKER, self.worker_id)
                 reg = registry()
-                t_assign = time.perf_counter()
+                t_assign = trace.now_s()
                 send_msg(
                     self.sock,
                     {
@@ -220,11 +323,15 @@ class _WorkerConn(threading.Thread):
                         "lo": seg.lo,
                         "hi": seg.hi,
                         "chaos_die": chaos,
+                        "run_id": cl.run_id,
+                        "ctx": ctx,
+                        "t_send": t_assign,
                     },
                 )
                 while True:
                     msg = recv_msg(self.sock)
-                    inflight = time.perf_counter() - t_assign
+                    t_now = trace.now_s()
+                    inflight = t_now - t_assign
                     if msg is None:
                         raise ConnectionError("worker closed mid-assignment")
                     if msg["type"] == "progress":
@@ -243,8 +350,20 @@ class _WorkerConn(threading.Thread):
                             worker=self.worker_id,
                             seg=seg.seg_id,
                         )
+                        if "t_hb" in msg and "t_recv" in msg:
+                            cl.clock_sample(
+                                self.worker_id, t_assign,
+                                msg["t_recv"], msg["t_hb"], t_now,
+                            )
                         continue
                     if msg["type"] in ("done", "error"):
+                        if "t_reply" in msg and "t_recv" in msg:
+                            cl.clock_sample(
+                                self.worker_id, t_assign,
+                                msg["t_recv"], msg["t_reply"], t_now,
+                            )
+                        if msg.get("telemetry"):
+                            cl.ship(self.worker_id, msg["telemetry"])
                         # one RPC round-trip: assign -> terminal reply
                         trace.add_span(
                             "rpc.assign",
@@ -252,6 +371,7 @@ class _WorkerConn(threading.Thread):
                             inflight,
                             worker=self.worker_id,
                             seg=seg.seg_id,
+                            ctx=ctx,
                             outcome=msg["type"],
                         )
                         reg.histogram("cluster.rpc_ms").observe(
@@ -292,6 +412,15 @@ class _Cluster:
         self.all_done = threading.Event()
         self.attempts: dict[int, int] = {}
         self.fatal: str | None = None
+        # distributed trace plane: one run id stamps every assign's trace
+        # context; shipped telemetry and clock samples accumulate here per
+        # worker until the end-of-run merge
+        self.run_id = os.urandom(4).hex()
+        self.tele_lock = threading.Lock()
+        self.telemetry: dict[int, list[dict]] = {}   # worker -> raw events
+        self.worker_registry: dict[int, dict] = {}   # latest snapshot
+        self.tele_dropped: dict[int, int] = {}       # cumulative per worker
+        self.clock: dict[int, _ClockAlign] = {}
         self.chaos: tuple[int, int] | None = None
         if config.chaos_kill:
             k, s = config.chaos_kill.split("@")
@@ -300,6 +429,26 @@ class _Cluster:
             self.chaos = (ANY_WORKER if k in ("any", "*") else int(k), int(s))
         for seg in segments:
             self.queue.put(seg)
+
+    def ship(self, worker_id: int, payload: dict) -> None:
+        """Accumulate a worker's piggybacked telemetry (raw worker-clock
+        events; rebasing happens once, at the end-of-run merge, with the
+        final best offset estimate)."""
+        with self.tele_lock:
+            self.telemetry.setdefault(worker_id, []).extend(
+                payload.get("events") or []
+            )
+            self.worker_registry[worker_id] = payload.get("registry") or {}
+            self.tele_dropped[worker_id] = int(payload.get("dropped") or 0)
+
+    def clock_sample(
+        self, worker_id: int, t_send, t_remote_recv, t_remote_send, t_done
+    ) -> None:
+        with self.tele_lock:
+            align = self.clock.get(worker_id)
+            if align is None:
+                align = self.clock[worker_id] = _ClockAlign()
+        align.sample(t_send, t_remote_recv, t_remote_send, t_done)
 
     def complete(self, res: SegmentResult) -> None:
         with self.lock:
@@ -315,21 +464,30 @@ class _Cluster:
     MAX_ATTEMPTS = 4
 
     def worker_failed(self, worker_id, current, reason: str) -> None:
+        # run_id + ctx let trace_report correlate the failure with the
+        # exact rpc.assign attempt on the merged timeline (ctx is None
+        # for failures between assignments)
         registry().counter("cluster.worker_failures").inc()
-        self.metrics.event("worker_failed", worker=worker_id, reason=reason)
+        self.metrics.event(
+            "worker_failed", worker=worker_id, reason=reason,
+            run_id=self.run_id, ctx=current[3] if current else None,
+        )
         self._requeue(current, reason)
 
     def segment_error(self, current, reason: str) -> None:
         """A worker survived but its segment raised: retry elsewhere, abort
         the run if the failure looks deterministic (MAX_ATTEMPTS strikes)."""
         registry().counter("cluster.segment_errors").inc()
-        self.metrics.event("segment_error", reason=reason.splitlines()[0])
+        self.metrics.event(
+            "segment_error", reason=reason.splitlines()[0],
+            run_id=self.run_id, ctx=current[3] if current else None,
+        )
         self._requeue(current, reason)
 
     def _requeue(self, current, reason: str) -> None:
         if current is None:
             return
-        seg_id, lo, hi = current
+        seg_id, lo, hi, ctx = current
         with self.lock:
             if seg_id in self.done:
                 return
@@ -344,19 +502,117 @@ class _Cluster:
         from sieve.segments import Segment
 
         registry().counter("cluster.reassigned").inc()
-        self.metrics.event("reassign", seg_id=seg_id)
+        self.metrics.event(
+            "reassign", seg_id=seg_id, run_id=self.run_id, ctx=ctx
+        )
         # one-shot chaos: don't re-kill the replacement owner
         if self.chaos and self.chaos[1] == seg_id:
             self.chaos = None
         self.queue.put(Segment(seg_id=seg_id, lo=lo, hi=hi))
 
 
+# Merged-trace layout: each worker's events land under a synthetic pid
+# (coordinator keeps its real one) so Perfetto shows one process track
+# per worker — disjoint from any real OS pid, and collision-free even
+# when workers on different hosts share pid numbers.
+_WORKER_PID_BASE = 1_000_000
+
+
+def _merge_worker_telemetry(cluster: _Cluster, metrics: MetricsLogger) -> dict:
+    """Rebase + merge every worker's shipped telemetry into the
+    coordinator's tracer and registry; returns the summary that rides
+    ``SieveResult.host_phases``.
+
+    Rebasing: ``coordinator_time = worker_time - offset`` with the
+    per-worker min-RTT NTP offset (error <= RTT/2). Each worker also gets
+    a ``clock.align`` instant carrying offset/rtt/err/dropped so
+    tools/trace_report.py --cluster can print the alignment report from
+    the trace file alone."""
+    tr = trace.get_tracer()
+    reg = registry()
+    merged: list[dict] = []
+    total_events = 0
+    total_dropped = 0
+    max_err = None
+    for wid in sorted(set(cluster.telemetry) | set(cluster.clock)):
+        events = cluster.telemetry.get(wid, [])
+        align = cluster.clock.get(wid)
+        off_us = (align.offset_s if align else 0.0) * 1e6
+        pid = _WORKER_PID_BASE + wid
+        merged.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"worker {wid}"},
+        })
+        for e in events:
+            e = dict(e)
+            e["pid"] = pid
+            if "ts" in e:
+                e["ts"] = round(e["ts"] - off_us, 3)
+            merged.append(e)
+        dropped = cluster.tele_dropped.get(wid, 0)
+        info: dict = {"worker": wid, "events": len(events),
+                      "dropped": dropped}
+        if align is not None and align.samples:
+            info.update(
+                offset_s=round(align.offset_s, 6),
+                rtt_s=round(align.rtt_s, 6),
+                err_s=round(align.err_s, 6),
+                samples=align.samples,
+            )
+            reg.gauge(f"cluster.worker{wid}.clock_offset_s").set(
+                round(align.offset_s, 6)
+            )
+            reg.gauge(f"cluster.worker{wid}.clock_err_s").set(
+                round(align.err_s, 6)
+            )
+            max_err = (
+                align.err_s if max_err is None else max(max_err, align.err_s)
+            )
+        merged.append({
+            "name": "clock.align", "ph": "i", "s": "p",
+            "ts": round(trace.now_s() * 1e6, 3), "pid": pid, "tid": 0,
+            "args": info,
+        })
+        # worker registry snapshot -> namespaced coordinator gauges, so
+        # `registry().snapshot()` covers the whole cluster
+        for name, snap in (cluster.worker_registry.get(wid) or {}).items():
+            base = f"cluster.worker{wid}.{name}"
+            if snap.get("type") in ("counter", "gauge"):
+                val = snap.get("value")
+                if isinstance(val, (int, float)):
+                    reg.gauge(base).set(val)
+            elif snap.get("type") == "histogram" and snap.get("count"):
+                reg.gauge(f"{base}.count").set(snap["count"])
+                reg.gauge(f"{base}.mean").set(round(snap["mean"], 4))
+        if dropped:
+            reg.counter("cluster.telemetry_dropped").inc(dropped)
+        reg.gauge(f"cluster.worker{wid}.telemetry_dropped").set(dropped)
+        total_events += len(events)
+        total_dropped += dropped
+        metrics.event("worker_telemetry", **info)
+    if merged:
+        tr.ingest(merged)
+    summary = {
+        "telemetry_workers": sum(
+            1 for w, ev in cluster.telemetry.items() if ev
+        ),
+        "telemetry_events": total_events,
+        "telemetry_dropped_events": total_dropped,
+    }
+    if max_err is not None:
+        summary["clock_err_max_s"] = round(max_err, 6)
+        reg.gauge("cluster.clock_err_max_s").set(summary["clock_err_max_s"])
+    return summary
+
+
 def run_cluster(config: SieveConfig) -> SieveResult:
     """Coordinator entry: serve assignments, spawn local workers (unless
     SIEVE_CLUSTER_NO_SPAWN=1 for externally-launched / multi-host workers),
-    merge results."""
+    merge results. With ``--trace`` the written file is the *merged*
+    cluster timeline: coordinator spans plus every worker's rebased
+    spans, one Perfetto process track per worker."""
     cfg = config
-    t0 = time.perf_counter()
+    t0 = trace.now_s()
     metrics = MetricsLogger(cfg)
     with trace.span("run.seed", backend=cfg.backend):
         seeds = seed_primes(cfg.seed_limit)
@@ -375,6 +631,7 @@ def run_cluster(config: SieveConfig) -> SieveResult:
 
     todo = [s for s in segs if s.seg_id not in restored]
     cluster = _Cluster(eff, seeds, todo, metrics, ledger)
+    trace.instant("cluster.run", run_id=cluster.run_id, workers=eff.workers)
     cluster.done.update(restored)
     if len(cluster.done) >= len(segs):
         cluster.n_expected = len(segs)
@@ -420,9 +677,13 @@ def run_cluster(config: SieveConfig) -> SieveResult:
         # behavior.
         floor_vps = float(os.environ.get("SIEVE_CLUSTER_FLOOR_VPS", "1e6"))
         workload_s = eff.n / (floor_vps * max(1, eff.workers))
-        deadline = time.time() + max(DEADLINE_S * 4, 300) + workload_s
+        # a *duration* budget, not a wall-clock appointment: it rides the
+        # monotonic trace clock like every other timestamp (a true wall
+        # deadline — e.g. a maintenance-window cutoff — would keep
+        # time.time() here, with this comment saying why)
+        deadline = trace.now_s() + max(DEADLINE_S * 4, 300) + workload_s
         while not cluster.all_done.is_set():
-            if time.time() > deadline:
+            if trace.now_s() > deadline:
                 raise RuntimeError(
                     f"cluster run timed out with {cluster.n_expected - len(cluster.done)}"
                     f" segments outstanding"
@@ -447,12 +708,16 @@ def run_cluster(config: SieveConfig) -> SieveResult:
             except subprocess.TimeoutExpired:
                 p.kill()
 
+    # merge after every conn thread has delivered its last ship(); doing
+    # it before the fatal check keeps worker-side context in the trace
+    # even when the run aborts
+    telemetry = _merge_worker_telemetry(cluster, metrics)
     if cluster.fatal:
         raise RuntimeError(f"cluster run aborted: {cluster.fatal}")
     results = [cluster.done[s.seg_id] for s in segs]
     with trace.span("run.merge"):
         pi, twins = merge_results(eff, results)
-    elapsed = time.perf_counter() - t0
+    elapsed = trace.now_s() - t0
     result = SieveResult(
         n=eff.n,
         pi=pi,
@@ -463,6 +728,7 @@ def run_cluster(config: SieveConfig) -> SieveResult:
         elapsed_s=elapsed,
         values_per_sec=(eff.n - 1) / elapsed if elapsed > 0 else float("inf"),
         segments=results,
+        host_phases=telemetry,
     )
     metrics.run_summary(result)
     return result

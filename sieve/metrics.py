@@ -40,14 +40,20 @@ if TYPE_CHECKING:
 
 # Required keys per event kind ("event" and "ts" are implicit on every
 # record). Kinds may carry extra keys; these are the stable contract.
+# Cluster robustness events carry the run id and the per-attempt trace
+# context ("ctx", None when the failure wasn't mid-assignment) so
+# tools/trace_report.py can pin them onto the merged timeline;
+# "worker_telemetry" records each worker's shipped span batch (plus
+# clock offset/err when an alignment sample exists).
 EVENT_SCHEMA: dict[str, set[str]] = {
     "segment": {"id", "lo", "hi", "ms", "count"},
     "run": {"n", "pi", "backend", "packing", "elapsed_s", "values_per_sec"},
     "resume": {"restored"},
-    "worker_failed": {"worker", "reason"},
-    "segment_error": {"reason"},
-    "reassign": {"seg_id"},
+    "worker_failed": {"worker", "reason", "run_id", "ctx"},
+    "segment_error": {"reason", "run_id", "ctx"},
+    "reassign": {"seg_id", "run_id", "ctx"},
     "host_prepare": {"prep_s"},
+    "worker_telemetry": {"worker", "events", "dropped"},
 }
 
 
@@ -318,7 +324,8 @@ class MetricsLogger:
             record["count_kind"] = kind
         phases = getattr(result, "host_phases", None)
         if phases:
-            # host-prepare pipeline health alongside the headline rate
+            # host-prepare pipeline health alongside the headline rate;
+            # cluster runs add telemetry-shipping / clock-alignment health
             for key in (
                 "prep_s",
                 "prep_values_per_sec",
@@ -327,6 +334,9 @@ class MetricsLogger:
                 "reduction_mode",
                 "postlude_fused_s",
                 "postlude_split_s",
+                "telemetry_workers",
+                "telemetry_dropped_events",
+                "clock_err_max_s",
             ):
                 if key in phases:
                     record[key] = phases[key]

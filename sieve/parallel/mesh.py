@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import functools
 import os
-import time
 
 import numpy as np
 
@@ -304,7 +303,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
     shard meaningfully."""
     cfg = config
     metrics = MetricsLogger(cfg)
-    t0 = time.perf_counter()
+    t0 = trace.now_s()
     # host_phases is span-derived: snapshot the process-wide tracer so
     # this run's phase totals are the delta (pipeline producer threads
     # start emitting prep.round spans as soon as the pipeline exists)
@@ -454,7 +453,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
         lw = vals[2 + 3 * ndev : 2 + 4 * ndev]
         # dispatch-to-fetch time; with a nonzero window this includes
         # overlapped rounds, so it bounds rather than equals device time
-        elapsed_round = time.perf_counter() - rt0
+        elapsed_round = trace.now_s() - rt0
         for i, s in enumerate(batch):
             res = SegmentResult(
                 seg_id=s.seg_id,
@@ -520,12 +519,12 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
     try:
         for rnd in todo:
             batch = segs[rnd * ndev : (rnd + 1) * ndev]
-            rt0 = time.perf_counter()
+            rt0 = trace.now_s()
             # nothing dispatched and undrained -> the device sits idle for
             # exactly the host time until the next dispatch below
             device_starved = not pending
             preps = pipeline.take(rnd)
-            t_prep = time.perf_counter()
+            t_prep = trace.now_s()
             trace.add_span("round.prep_wait", rt0, t_prep - rt0, round=rnd)
             nbits_v = np.array([p.nbits for p in preps], np.int32)
             # gap_ok[d] = 1 iff (last candidate of seg d, first of seg d+1)
@@ -630,7 +629,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
                     pmask, gap_ok,
                 )
                 dispatch_step = step
-            t_stack = time.perf_counter()
+            t_stack = trace.now_s()
             trace.add_span("round.stack", t_prep, t_stack - t_prep, round=rnd)
             if device_starved:
                 # prep-wait + stacking with an empty device queue is true
@@ -655,7 +654,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
     results = [done[s.seg_id] for s in segs]
     with trace.span("run.merge"):
         pi, twin_pairs = merge_results(cfg, results)
-    elapsed = time.perf_counter() - t0
+    elapsed = trace.now_s() - t0
 
     chain_phases: dict[str, float] = {}
     for st in pipeline.states:

@@ -30,6 +30,15 @@ Per-run accounting over the process-wide tracer uses snapshot diffs::
     snap = trace.snapshot()
     ...           # run spans on any number of threads
     agg = trace.since(snap)   # {name: (delta_seconds, delta_count)}
+
+Distributed runs (sieve/cluster.py) extend this to one timeline per
+*cluster*: each worker process captures its own spans into a bounded
+drop-oldest ring (:meth:`Tracer.set_event_limit` /
+:meth:`Tracer.drain_events`), ships them on its RPC replies, and the
+coordinator rebases the timestamps onto its own epoch (clock offsets are
+estimated NTP-style from the RPC legs) before folding them back in with
+:meth:`Tracer.ingest` — so a single ``--trace`` file carries coordinator
++ per-worker tracks.
 """
 
 from __future__ import annotations
@@ -73,7 +82,9 @@ class Span:
     def __exit__(self, *exc) -> bool:
         t1 = time.perf_counter()
         self.elapsed = t1 - self.t0
-        self._tracer._record(self.name, self.t0, t1, self.args)
+        self._tracer._record(
+            self.name, self.t0 - _EPOCH, t1 - _EPOCH, self.args
+        )
         return False
 
 
@@ -87,6 +98,10 @@ class Tracer:
         self._events: list[dict] = []
         self._totals: dict[str, list] = {}  # name -> [total_s, count]
         self._tids_named: set[int] = set()
+        # bounded capture (telemetry shipping): keep at most this many
+        # events, dropping the oldest non-metadata event on overflow
+        self._max_events: int | None = None
+        self._dropped = 0
 
     # --- recording -----------------------------------------------------------
 
@@ -96,6 +111,7 @@ class Tracer:
     def _record(
         self, name: str, t0: float, t1: float, args: dict | None
     ) -> None:
+        # t0/t1 are epoch-relative seconds (the ``now_s()`` clock)
         with self._lock:
             tot = self._totals.get(name)
             if tot is None:
@@ -108,8 +124,8 @@ class Tracer:
     def add_span(
         self, name: str, t0: float, duration_s: float, **args: Any
     ) -> None:
-        """Record an already-measured interval (``t0`` is a raw
-        ``perf_counter`` value) — for synthetic spans like device-idle
+        """Record an already-measured interval (``t0`` is epoch-relative,
+        i.e. a :func:`now_s` value) — for synthetic spans like device-idle
         windows whose bounds were observed rather than entered/exited."""
         self._record(name, t0, t0 + duration_s, args or None)
 
@@ -131,6 +147,7 @@ class Tracer:
                     **({"args": args} if args else {}),
                 }
             )
+            self._trim()
 
     def counter(self, name: str, value: float) -> None:
         """Sample a counter/gauge value onto the trace timeline."""
@@ -149,6 +166,7 @@ class Tracer:
                     "args": {"value": value},
                 }
             )
+            self._trim()
 
     def _append_event(
         self, name: str, t0: float, t1: float, args: dict | None
@@ -169,7 +187,7 @@ class Tracer:
         ev = {
             "name": name,
             "ph": "X",
-            "ts": round((t0 - _EPOCH) * 1e6, 3),
+            "ts": round(t0 * 1e6, 3),
             "dur": round((t1 - t0) * 1e6, 3),
             "pid": os.getpid(),
             "tid": tid,
@@ -177,6 +195,21 @@ class Tracer:
         if args:
             ev["args"] = args
         self._events.append(ev)
+        self._trim()
+
+    def _trim(self) -> None:
+        # caller holds the lock; metadata ("M") events are never evicted —
+        # they name the tracks every surviving event still needs
+        if self._max_events is None:
+            return
+        while len(self._events) > self._max_events:
+            for i, e in enumerate(self._events):
+                if e.get("ph") != "M":
+                    del self._events[i]
+                    self._dropped += 1
+                    break
+            else:
+                return  # only metadata left; nothing evictable
 
     # --- control / export ----------------------------------------------------
 
@@ -188,6 +221,7 @@ class Tracer:
             if clear:
                 self._events.clear()
                 self._tids_named.clear()
+                self._dropped = 0
             self.enabled = True
 
     def disable(self) -> None:
@@ -199,6 +233,49 @@ class Tracer:
             self._events.clear()
             self._totals.clear()
             self._tids_named.clear()
+            self._dropped = 0
+
+    def set_event_limit(self, max_events: int | None) -> None:
+        """Bound the capture buffer to ``max_events`` (None = unbounded).
+
+        On overflow the oldest non-metadata event is dropped and counted
+        in :attr:`dropped` — worker processes run with a bounded ring so
+        telemetry payloads shipped over the cluster RPC stay small."""
+        with self._lock:
+            self._max_events = max_events
+            self._trim()
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted by the ring limit since the last fresh enable."""
+        with self._lock:
+            return self._dropped
+
+    def drain_events(self) -> tuple[list[dict], int]:
+        """Take (and clear) the captured events; returns ``(events,
+        cumulative_dropped)``. Thread-name bookkeeping is kept so a later
+        drain does not re-emit metadata already shipped — the consumer is
+        expected to accumulate successive drains in order."""
+        with self._lock:
+            events, self._events = self._events, []
+            return events, self._dropped
+
+    def ingest(self, events: list[dict]) -> None:
+        """Merge foreign, already-rebased events (a worker's shipped
+        telemetry) into this tracer: complete-span durations fold into
+        the aggregate totals, and the raw events join the capture buffer
+        when capture is on. The ring limit is not applied here — merged
+        cluster traces are bounded by each worker's ship ring instead."""
+        with self._lock:
+            for e in events:
+                if e.get("ph") == "X":
+                    tot = self._totals.get(e["name"])
+                    if tot is None:
+                        tot = self._totals[e["name"]] = [0.0, 0]
+                    tot[0] += e.get("dur", 0.0) / 1e6
+                    tot[1] += 1
+                if self.enabled:
+                    self._events.append(e)
 
     def events(self) -> list[dict]:
         with self._lock:
@@ -278,6 +355,18 @@ def enabled() -> bool:
 
 def save(path_or_file: str | TextIO) -> None:
     _TRACER.save(path_or_file)
+
+
+def set_event_limit(max_events: int | None) -> None:
+    _TRACER.set_event_limit(max_events)
+
+
+def drain_events() -> tuple[list[dict], int]:
+    return _TRACER.drain_events()
+
+
+def ingest(events: list[dict]) -> None:
+    _TRACER.ingest(events)
 
 
 def snapshot() -> dict[str, tuple[float, int]]:

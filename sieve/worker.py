@@ -5,18 +5,69 @@ cpu-cluster, jax, tpu-pallas) implements the identical
 ``process_segment(lo, hi, seed_primes) -> SegmentResult`` signature and is
 parity-tested pairwise. The TPU backend plugs in through this same boundary,
 "alongside the CPU-cluster path" (BASELINE.json north_star).
+
+This module is also the worker-side telemetry seam for the cluster
+transport: a worker process records its spans (``worker.recv`` /
+``worker.segment`` / ``worker.reply`` plus the backend's own
+``segment.*`` spans) and registry counters locally, and
+:func:`telemetry_payload` drains them into a bounded payload that rides
+the terminal ``done``/``error`` RPC reply back to the coordinator, which
+rebases and merges them into one cluster timeline (sieve/cluster.py).
 """
 
 from __future__ import annotations
 
 import abc
 import dataclasses
+import os
 from typing import TYPE_CHECKING
 
 import numpy as np
 
+from sieve import trace
+from sieve.metrics import registry
+
 if TYPE_CHECKING:
     from sieve.config import SieveConfig
+
+# Worker-side event ring: at most this many trace events are held (and
+# therefore shipped per reply); overflow drops the oldest event and is
+# counted, so truncation is visible (never silent) on the coordinator.
+TELEMETRY_RING_EVENTS = 4096
+
+
+def telemetry_ring_size() -> int:
+    """Ring capacity: ``SIEVE_TELEMETRY_RING`` env override, 0 disables."""
+    return int(os.environ.get("SIEVE_TELEMETRY_RING", TELEMETRY_RING_EVENTS))
+
+
+def telemetry_start() -> bool:
+    """Begin bounded span capture for telemetry shipping (worker role).
+
+    Returns False (capture untouched) when shipping is disabled via
+    ``SIEVE_TELEMETRY_RING=0``."""
+    limit = telemetry_ring_size()
+    if limit <= 0:
+        return False
+    tr = trace.get_tracer()
+    tr.set_event_limit(limit)
+    tr.enable()
+    return True
+
+
+def telemetry_payload(worker_id: int) -> dict:
+    """Drain the not-yet-shipped trace events + a registry snapshot.
+
+    Timestamps are on the *worker's* trace epoch; the coordinator rebases
+    them using its NTP-style per-worker clock-offset estimate. ``dropped``
+    is the cumulative ring-eviction count for this worker."""
+    events, dropped = trace.drain_events()
+    return {
+        "worker_id": worker_id,
+        "events": events,
+        "dropped": dropped,
+        "registry": registry().snapshot(),
+    }
 
 
 @dataclasses.dataclass

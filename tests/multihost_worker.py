@@ -5,13 +5,40 @@ them into one 8-device mesh and run_mesh executes the identical SPMD
 program on both — the DCN scaling story of SURVEY.md section 5.8, minus
 the actual second host.
 
+Also the external-worker body for the cpu-cluster trace-plane test:
+``multihost_worker.py <coordinator_addr> cluster <worker_id>`` connects
+a real subprocess worker to an in-test coordinator (retrying while the
+coordinator is still binding), exercising telemetry shipping and clock
+alignment across genuine process clocks.
+
 Usage: multihost_worker.py <coordinator_addr> <num_processes> <process_id>
+       multihost_worker.py <coordinator_addr> cluster <worker_id>
 """
 
 import sys
+import time
+
+
+def cluster_main() -> int:
+    addr, worker_id = sys.argv[1], int(sys.argv[3])
+    from sieve.cluster import serve_worker
+    from sieve.config import SieveConfig
+
+    cfg = SieveConfig(n=10**5, backend="cpu-cluster", coordinator_addr=addr)
+    deadline = time.monotonic() + 30
+    while True:
+        try:
+            serve_worker(cfg, worker_id)
+            return 0
+        except ConnectionRefusedError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
 
 
 def main() -> int:
+    if len(sys.argv) > 2 and sys.argv[2] == "cluster":
+        return cluster_main()
     addr, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     import jax
 

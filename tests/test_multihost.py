@@ -1,8 +1,16 @@
 """Multi-host (DCN) mesh test: two real processes, one logical 8-device
 mesh via jax.distributed.initialize — SURVEY.md section 5.8's "multi-host
 runs the identical program over DCN" claim, executed rather than asserted.
+
+Also the distributed trace plane acceptance test: a 2-worker cpu-cluster
+run with externally-launched workers (real process clocks) must merge
+into one Chrome-trace timeline with a track per worker, every rpc.assign
+correlated to its worker.segment by trace context, >=95% of the rebased
+worker spans nesting inside their coordinator span, and no telemetry
+dropped by the ship ring.
 """
 
+import json
 import os
 import socket
 import subprocess
@@ -56,3 +64,132 @@ def test_two_process_mesh():
     for i, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"process {i} failed:\n{out}\n{err}"
         assert f"MULTIHOST_OK {i} 9592 1224" in out, (out, err)
+
+
+# --- distributed trace plane -------------------------------------------------
+
+
+def _worker_env() -> dict:
+    worker = Path(__file__).parent / "multihost_worker.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = str(worker.parent.parent)
+    return env
+
+
+def test_cluster_merged_trace(tmp_path, monkeypatch):
+    # external workers (SIEVE_CLUSTER_NO_SPAWN): each worker is a real
+    # subprocess with its own perf_counter epoch, so the coordinator's
+    # clock alignment has genuine offsets to recover — unlike the
+    # spawn-local path this coordinator never forks workers itself
+    from sieve import trace
+    from sieve.cluster import run_cluster
+    from sieve.config import SieveConfig
+    from tools.trace_report import cluster_report, load_all
+
+    monkeypatch.setenv("SIEVE_CLUSTER_NO_SPAWN", "1")
+    addr = f"127.0.0.1:{_free_port()}"
+    worker = Path(__file__).parent / "multihost_worker.py"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), addr, "cluster", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=_worker_env(), cwd=str(worker.parent.parent),
+        )
+        for i in range(2)
+    ]
+    tr = trace.get_tracer()
+    tr.enable()
+    try:
+        res = run_cluster(SieveConfig(
+            n=10**5, backend="cpu-cluster", workers=2, n_segments=8,
+            quiet=True, coordinator_addr=addr,
+        ))
+    finally:
+        tr.disable()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.communicate(timeout=30)
+    assert res.pi == 9_592
+
+    path = tmp_path / "cluster.trace.json"
+    tr.save(str(path))
+    events = load_all(str(path))
+
+    # one Perfetto process track per worker
+    tracks = {
+        e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and str(e["args"].get("name", "")).startswith("worker ")
+    }
+    assert tracks == {"worker 0", "worker 1"}
+
+    # every rpc.assign correlates to the worker.segment of the same
+    # attempt via the propagated trace context
+    spans = [e for e in events if e.get("ph") == "X"]
+    rpc = [e for e in spans if e["name"] == "rpc.assign"]
+    seg_by_ctx = {
+        e["args"]["ctx"]: e
+        for e in spans
+        if e["name"] == "worker.segment" and e.get("args", {}).get("ctx")
+    }
+    assert len(rpc) == 8
+    nested = 0
+    for r in rpc:
+        w = seg_by_ctx.get(r["args"]["ctx"])
+        assert w is not None, f"rpc.assign {r['args']} has no worker.segment"
+        if (w["ts"] >= r["ts"]
+                and w["ts"] + w["dur"] <= r["ts"] + r["dur"]):
+            nested += 1
+    assert nested >= 0.95 * len(rpc), f"only {nested}/{len(rpc)} nested"
+
+    # telemetry shipping and clock alignment health
+    hp = res.host_phases
+    assert hp["telemetry_workers"] == 2
+    assert hp["telemetry_dropped_events"] == 0
+    assert 0 <= hp["clock_err_max_s"] < 1.0
+    aligns = [e for e in events if e.get("name") == "clock.align"]
+    assert len(aligns) == 2
+    for a in aligns:
+        assert a["args"]["dropped"] == 0
+        # error bound is half the min-RTT (fields rounded independently)
+        assert a["args"]["err_s"] == pytest.approx(
+            a["args"]["rtt_s"] / 2, abs=2e-6
+        )
+
+    # the cluster view renders all required reports from this file
+    text = cluster_report(events)
+    assert "per-worker utilization" in text
+    assert "rpc-wait vs compute" in text
+    assert "nested after rebase: 8/8" in text or nested < 8
+    assert "max clock-alignment error" in text
+    assert "straggler ranking" in text
+
+
+def test_cluster_cli_trace_merges_and_reports(tmp_path, capsys):
+    # the CLI path: --trace on cpu-cluster writes the merged timeline
+    # (spawn-local workers) and trace_report --cluster renders it
+    from sieve.cli import main as sieve_main
+    from tools.trace_report import main as report_main
+
+    path = tmp_path / "cluster.trace.json"
+    rc = sieve_main([
+        "--n", "1e5", "--backend", "cpu-cluster", "--workers", "2",
+        "--segments", "8", "--quiet", "--json",
+        "--coordinator-addr", "127.0.0.1:0", "--trace", str(path),
+    ])
+    assert rc == 0
+    captured = capsys.readouterr()
+    out = json.loads(captured.out)
+    assert out["pi"] == 9_592
+    assert out["host_phases"]["telemetry_workers"] == 2
+    # no truncation -> no CLI warning about the ship ring
+    assert "telemetry truncated" not in captured.err
+
+    assert report_main(["--cluster", str(path)]) == 0
+    text = capsys.readouterr().out
+    assert "cluster timeline: 2 workers" in text
+    assert "per-worker utilization" in text
+    assert "max clock-alignment error" in text

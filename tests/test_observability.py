@@ -37,7 +37,7 @@ def test_span_aggregation_without_capture():
         pass
     with tr.span("phase.a"):
         pass
-    tr.add_span("phase.b", time.perf_counter(), 0.25)
+    tr.add_span("phase.b", trace.now_s(), 0.25)
     agg = tr.snapshot()
     assert agg["phase.a"][1] == 2
     assert agg["phase.b"] == (pytest.approx(0.25), 1)
@@ -96,10 +96,10 @@ def test_spans_from_threads_get_own_tracks():
 
 def test_snapshot_since_diff():
     tr = trace.Tracer()
-    tr.add_span("x", time.perf_counter(), 1.0)
+    tr.add_span("x", trace.now_s(), 1.0)
     snap = tr.snapshot()
-    tr.add_span("x", time.perf_counter(), 2.0)
-    tr.add_span("y", time.perf_counter(), 0.5)
+    tr.add_span("x", trace.now_s(), 2.0)
+    tr.add_span("y", trace.now_s(), 0.5)
     delta = tr.since(snap)
     assert delta["x"] == (pytest.approx(2.0), 1)
     assert delta["y"] == (pytest.approx(0.5), 1)
@@ -219,7 +219,8 @@ def test_quiet_gates_only_segment_console_lines(memsink):
         first_word=0, last_word=0, nbits=0, elapsed_s=0.001,
     )
     log.segment(seg)
-    log.event("worker_failed", worker=0, reason="killed")
+    log.event("worker_failed", worker=0, reason="killed",
+              run_id="deadbeef", ctx=None)
     console = [json.loads(line) for line in out.getvalue().splitlines()]
     # quiet console: robustness event yes, per-segment line no
     assert [r["event"] for r in console] == ["worker_failed"]

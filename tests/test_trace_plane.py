@@ -1,0 +1,241 @@
+"""Distributed trace-plane unit tests: clock alignment math, the
+bounded telemetry ring (drain/drop accounting), event ingestion on the
+coordinator side, the worker ship payload, and the bench_compare gate.
+
+The end-to-end merged-timeline acceptance test (real worker
+subprocesses, rebased nesting, cluster_report) lives in
+tests/test_multihost.py; these pin the pieces it composes.
+"""
+
+import json
+
+import pytest
+
+from sieve import trace
+from sieve.cluster import _ClockAlign
+from sieve.metrics import validate_record
+from tools.bench_compare import compare, extract_metrics, find_rounds
+from tools.bench_compare import main as bench_main
+
+# --- clock alignment ---------------------------------------------------------
+
+
+def test_clock_align_recovers_offset_exactly():
+    # symmetric link: a worker whose clock reads coordinator + 7.25 s,
+    # one-way latency 2 ms each direction -> offset recovered exactly,
+    # rtt = 4 ms, err bound = 2 ms
+    a = _ClockAlign()
+    off, lat = 7.25, 0.002
+    t_send = 100.0
+    a.sample(t_send, t_send + lat + off, t_send + lat + off + 0.01,
+             t_send + 2 * lat + 0.01)
+    assert a.offset_s == pytest.approx(off)
+    assert a.rtt_s == pytest.approx(2 * lat)
+    assert a.err_s == pytest.approx(lat)
+    assert a.samples == 1
+
+
+def test_clock_align_keeps_min_rtt_sample():
+    a = _ClockAlign()
+    # noisy first sample: 100 ms rtt, asymmetric -> biased offset
+    a.sample(0.0, 0.09 + 5.0, 0.09 + 5.0, 0.1)
+    biased = a.offset_s
+    # clean second sample: 1 ms rtt -> replaces the noisy estimate
+    a.sample(10.0, 10.0005 + 5.0, 10.0005 + 5.0, 10.001)
+    assert a.rtt_s == pytest.approx(0.001)
+    assert a.offset_s == pytest.approx(5.0, abs=1e-3)
+    assert a.offset_s != biased
+    # a worse sample later must NOT displace the kept estimate
+    a.sample(20.0, 20.05 + 5.0, 20.05 + 5.0, 20.1)
+    assert a.rtt_s == pytest.approx(0.001)
+    assert a.samples == 3
+
+
+def test_clock_align_equal_rtt_refreshes_for_drift():
+    # ties refresh to the newest sample so slow drift is tracked
+    # (binary-exact values so both RTTs compare equal)
+    a = _ClockAlign()
+    a.sample(0.0, 0.25 + 5.0, 0.25 + 5.0, 0.5)
+    a.sample(64.0, 64.25 + 5.3125, 64.25 + 5.3125, 64.5)
+    assert a.rtt_s == 0.5
+    assert a.offset_s == 5.3125
+
+
+def test_clock_align_no_samples_is_infinite_error():
+    a = _ClockAlign()
+    assert a.err_s == float("inf")
+    assert a.samples == 0
+
+
+# --- the bounded event ring --------------------------------------------------
+
+
+def test_ring_drops_oldest_and_counts():
+    tr = trace.Tracer()
+    tr.set_event_limit(3)
+    tr.enable()
+    for i in range(6):
+        tr.instant("e", i=i)
+    tr.disable()
+    events, dropped = tr.drain_events()
+    kept = [e["args"]["i"] for e in events if e["name"] == "e"]
+    assert kept == [3, 4, 5]  # oldest evicted first
+    assert dropped == 3
+    assert tr.dropped == 3
+    # drain empties the buffer but keeps the cumulative drop counter
+    assert tr.drain_events() == ([], 3)
+
+
+def test_ring_never_evicts_metadata():
+    # "M" records (process/thread names) are required to render every
+    # later span; the ring must only evict payload events
+    tr = trace.Tracer()
+    tr.enable()
+    with tr.span("first"):
+        pass
+    tr.set_event_limit(2)
+    for i in range(10):
+        tr.instant("e", i=i)
+    tr.disable()
+    events = tr.events()
+    mphases = [e for e in events if e["ph"] == "M"]
+    assert mphases, "metadata records were evicted"
+    non_m = [e for e in events if e["ph"] != "M"]
+    assert len(non_m) <= 2
+
+
+def test_ring_disabled_by_default():
+    tr = trace.Tracer()
+    tr.enable()
+    for i in range(10_000):
+        tr.instant("e", i=i)
+    tr.disable()
+    assert tr.dropped == 0
+    assert len(tr.events()) == 10_000
+
+
+def test_ingest_folds_durations_and_appends():
+    tr = trace.Tracer()
+    shipped = [
+        {"name": "worker.segment", "ph": "X", "ts": 1000.0, "dur": 2000.0,
+         "pid": 1, "tid": 1, "args": {}},
+        {"name": "worker.segment", "ph": "X", "ts": 5000.0, "dur": 1000.0,
+         "pid": 1, "tid": 1, "args": {}},
+        {"name": "hb", "ph": "i", "ts": 1.0, "pid": 1, "tid": 1, "s": "t"},
+    ]
+    tr.ingest(shipped)  # capture off: totals only
+    assert tr.snapshot()["worker.segment"] == (pytest.approx(0.003), 2)
+    assert tr.events() == []
+    tr.enable()
+    tr.ingest(shipped)
+    tr.disable()
+    assert len(tr.events()) == 3
+
+
+# --- the worker ship payload -------------------------------------------------
+
+
+def test_telemetry_payload_shape(monkeypatch):
+    from sieve.worker import telemetry_payload, telemetry_ring_size
+
+    monkeypatch.setenv("SIEVE_TELEMETRY_RING", "128")
+    assert telemetry_ring_size() == 128
+    tr = trace.get_tracer()
+    tr.enable()
+    try:
+        with tr.span("worker.segment", seg=0):
+            pass
+    finally:
+        tr.disable()
+    payload = telemetry_payload(worker_id=3)
+    assert payload["worker_id"] == 3
+    assert payload["dropped"] == 0
+    assert isinstance(payload["registry"], dict)
+    names = [e["name"] for e in payload["events"] if e.get("ph") == "X"]
+    assert "worker.segment" in names
+    json.dumps(payload)  # must survive the JSON wire format
+    # drained: a second ship carries no stale events
+    assert telemetry_payload(worker_id=3)["events"] == []
+
+
+def test_telemetry_ring_env_zero_disables(monkeypatch):
+    from sieve.worker import telemetry_start
+
+    monkeypatch.setenv("SIEVE_TELEMETRY_RING", "0")
+    assert telemetry_start() is False
+
+
+# --- new event kinds ---------------------------------------------------------
+
+
+def test_schema_new_kinds_validate():
+    validate_record({
+        "event": "worker_failed", "ts": 0.0, "worker": 1,
+        "reason": "killed", "run_id": "ab12cd34", "ctx": "ab12cd34/3.0",
+    })
+    validate_record({
+        "event": "reassign", "ts": 0.0, "seg_id": 3,
+        "run_id": "ab12cd34", "ctx": "ab12cd34/3.0",
+    })
+    validate_record({
+        "event": "worker_telemetry", "ts": 0.0, "worker": 0,
+        "events": 17, "dropped": 0,
+    })
+    with pytest.raises(ValueError, match="missing keys"):
+        validate_record({
+            "event": "worker_failed", "ts": 0.0, "worker": 1,
+            "reason": "killed",  # run_id/ctx now part of the contract
+        })
+
+
+# --- bench_compare -----------------------------------------------------------
+
+
+def _bench_doc(value: float, rc: int = 0) -> str:
+    line = json.dumps({
+        "metric": "sieve_throughput", "value": value,
+        "unit": "values/s/chip", "vs_baseline": 1.0,
+    })
+    return json.dumps({
+        "n": 1, "cmd": "bench", "rc": rc,
+        "tail": f"warmup noise\n{line}\n",
+        "parsed": json.loads(line),
+    })
+
+
+def test_bench_compare_rounds_sorted_by_suffix_not_mtime(tmp_path):
+    # r10 written before r09: numeric suffix wins over mtime
+    (tmp_path / "BENCH_r10.json").write_text(_bench_doc(200.0))
+    (tmp_path / "BENCH_r09.json").write_text(_bench_doc(100.0))
+    rounds = find_rounds(str(tmp_path), "BENCH")
+    assert [r for r, _ in rounds] == [9, 10]
+
+
+def test_bench_compare_ok_and_regression(tmp_path, capsys):
+    (tmp_path / "BENCH_r01.json").write_text(_bench_doc(100.0))
+    (tmp_path / "BENCH_r02.json").write_text(_bench_doc(95.0))
+    assert bench_main(["--dir", str(tmp_path)]) == 0  # -5% within gate
+    (tmp_path / "BENCH_r03.json").write_text(_bench_doc(80.0))
+    assert bench_main(["--dir", str(tmp_path)]) == 1  # -15.8% fails
+    assert "REGRESSION" in capsys.readouterr().out
+    # a looser threshold admits the same delta
+    assert bench_main(["--dir", str(tmp_path), "--threshold", "0.2"]) == 0
+
+
+def test_bench_compare_newest_round_rc_failure(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(_bench_doc(100.0))
+    (tmp_path / "BENCH_r02.json").write_text(_bench_doc(100.0, rc=2))
+    assert bench_main(["--dir", str(tmp_path)]) == 1
+
+
+def test_bench_compare_single_round_is_not_a_failure(tmp_path, capsys):
+    (tmp_path / "BENCH_r01.json").write_text(_bench_doc(100.0))
+    assert bench_main(["--dir", str(tmp_path)]) == 0
+    assert "need 2 to compare" in capsys.readouterr().out
+
+
+def test_bench_compare_metric_disappearance_fails():
+    old = extract_metrics(json.loads(_bench_doc(100.0)))
+    lines, regressions = compare(old, {}, threshold=0.10)
+    assert regressions and "disappeared" in regressions[0]
+    assert any("GONE" in line for line in lines)

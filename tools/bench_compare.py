@@ -1,0 +1,159 @@
+"""Diff the two newest benchmark result files and gate on regressions.
+
+The bench driver writes one ``BENCH_r<NN>.json`` (and one
+``MULTICHIP_r<NN>.json``) per round into the repo root. Each BENCH file
+carries the benchmark subprocess's ``rc``, its stderr ``tail`` (with
+one JSON metric line per benchmark:
+``{"metric": ..., "value": ..., "unit": "values/s/chip", ...}``), and
+the last metric re-parsed under ``parsed``. This tool pairs the two
+newest rounds by metric name and prints the delta for each; it exits
+nonzero when any throughput metric (``unit == "values/s/chip"``)
+regressed by more than ``--threshold`` (default 10%), or when the
+newest round itself failed (``rc != 0`` / ``ok == false``).
+
+Round order comes from the ``_r<NN>`` filename suffix, NOT mtime — a
+re-checkout or ``touch`` must not reorder history.
+
+Usage: python tools/bench_compare.py [--dir DIR] [--threshold 0.10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def find_rounds(bench_dir: str, prefix: str) -> list[tuple[int, str]]:
+    """(round, path) pairs for ``<prefix>_r<NN>.json``, round ascending."""
+    rounds = []
+    for path in glob.glob(os.path.join(bench_dir, f"{prefix}_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if m:
+            rounds.append((int(m.group(1)), path))
+    rounds.sort()
+    return rounds
+
+
+def extract_metrics(doc: dict) -> dict[str, dict]:
+    """Metric-name -> record from a BENCH round document.
+
+    Metrics live as JSON lines inside the stderr ``tail`` (one per
+    benchmark); ``parsed`` duplicates the last one and covers old
+    rounds whose tail was truncated past the metric lines.
+    """
+    metrics: dict[str, dict] = {}
+    for line in doc.get("tail", "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            metrics[rec["metric"]] = rec
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        metrics.setdefault(parsed["metric"], parsed)
+    return metrics
+
+
+def compare(
+    old: dict[str, dict], new: dict[str, dict], threshold: float
+) -> tuple[list[str], list[str]]:
+    """(report lines, regression descriptions) for old -> new."""
+    lines: list[str] = []
+    regressions: list[str] = []
+    for name in sorted(old.keys() | new.keys()):
+        o, n = old.get(name), new.get(name)
+        if o is None:
+            lines.append(f"  {name}: NEW  {n['value']:.4g} {n.get('unit', '')}")
+            continue
+        if n is None:
+            lines.append(f"  {name}: GONE (was {o['value']:.4g})")
+            regressions.append(f"{name} disappeared from the newest round")
+            continue
+        ov, nv = float(o["value"]), float(n["value"])
+        delta = (nv - ov) / ov if ov else 0.0
+        unit = n.get("unit", "")
+        gated = unit == "values/s/chip"
+        verdict = ""
+        if gated and delta < -threshold:
+            verdict = f"  REGRESSION (> {threshold:.0%} drop)"
+            regressions.append(
+                f"{name}: {ov:.4g} -> {nv:.4g} ({delta:+.1%})"
+            )
+        lines.append(
+            f"  {name}: {ov:.4g} -> {nv:.4g} {unit} "
+            f"({delta:+.1%}){verdict}"
+        )
+    return lines, regressions
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="diff the two newest BENCH_r*.json rounds by metric "
+        "name; exit nonzero on a >threshold throughput regression"
+    )
+    p.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    p.add_argument("--threshold", type=float, default=0.10,
+                   help="relative drop in a values/s/chip metric that "
+                        "fails the gate (default 0.10)")
+    args = p.parse_args(argv)
+
+    failures: list[str] = []
+
+    rounds = find_rounds(args.dir, "BENCH")
+    if len(rounds) < 2:
+        print(f"bench_compare: {len(rounds)} BENCH round(s) in "
+              f"{args.dir} — need 2 to compare; nothing to gate")
+        return 0
+
+    (old_r, old_path), (new_r, new_path) = rounds[-2], rounds[-1]
+    with open(old_path) as f:
+        old_doc = json.load(f)
+    with open(new_path) as f:
+        new_doc = json.load(f)
+    print(f"bench_compare: round r{old_r:02d} -> r{new_r:02d}")
+    if new_doc.get("rc", 0) != 0:
+        failures.append(
+            f"newest BENCH round r{new_r:02d} failed (rc={new_doc['rc']})"
+        )
+    lines, regressions = compare(
+        extract_metrics(old_doc), extract_metrics(new_doc), args.threshold
+    )
+    print("\n".join(lines) if lines else "  (no metrics parsed)")
+    failures.extend(regressions)
+
+    mc = find_rounds(args.dir, "MULTICHIP")
+    if len(mc) >= 2:
+        with open(mc[-1][1]) as f:
+            mc_new = json.load(f)
+        status = ("skipped" if mc_new.get("skipped")
+                  else "ok" if mc_new.get("ok") else "FAILED")
+        print(f"multichip r{mc[-1][0]:02d}: {status} "
+              f"(n_devices={mc_new.get('n_devices')})")
+        if not mc_new.get("ok") and not mc_new.get("skipped"):
+            failures.append(
+                f"newest MULTICHIP round r{mc[-1][0]:02d} failed "
+                f"(rc={mc_new.get('rc')})"
+            )
+
+    if failures:
+        for f_ in failures:
+            print(f"bench_compare: FAIL: {f_}", file=sys.stderr)
+        return 1
+    print("bench_compare: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

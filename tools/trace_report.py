@@ -5,7 +5,13 @@ The input is Chrome trace-event JSON as written by sieve/trace.py
 (``{"traceEvents": [...]}``; a bare event array is accepted too), so the
 same file loads in Perfetto / ``chrome://tracing`` for the visual view.
 
-Usage: python tools/trace_report.py TRACE_FILE [--top N]
+``--cluster`` renders the distributed view of a merged cpu-cluster
+trace (coordinator + per-worker tracks, see sieve/cluster.py):
+per-worker utilization/idle, the RPC-wait vs compute split, straggler
+ranking, rpc.assign <-> worker.segment correlation/nesting after clock
+rebasing, and the per-worker clock-alignment error report.
+
+Usage: python tools/trace_report.py TRACE_FILE [--top N] [--cluster]
 """
 
 from __future__ import annotations
@@ -18,15 +24,19 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def load_events(path_or_file) -> list[dict]:
-    """Complete ("X") span events from a trace file, sorted by start."""
+def load_all(path_or_file) -> list[dict]:
+    """Every event in a trace file (spans, instants, counters, metadata)."""
     if hasattr(path_or_file, "read"):
         doc = json.load(path_or_file)
     else:
         with open(path_or_file) as f:
             doc = json.load(f)
-    events = doc["traceEvents"] if isinstance(doc, dict) else doc
-    spans = [e for e in events if e.get("ph") == "X"]
+    return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def load_events(path_or_file) -> list[dict]:
+    """Complete ("X") span events from a trace file, sorted by start."""
+    spans = [e for e in load_all(path_or_file) if e.get("ph") == "X"]
     spans.sort(key=lambda e: e["ts"])
     return spans
 
@@ -118,6 +128,160 @@ def report(spans: list[dict], top: int = 10) -> str:
     return "\n".join(lines)
 
 
+def cluster_report(events: list[dict], top: int = 10) -> str:
+    """The distributed view of a merged cluster trace (pure function).
+
+    Expects the event stream written by a cpu-cluster ``--trace`` run:
+    coordinator ``rpc.assign`` spans, per-worker process tracks
+    (``process_name`` = "worker N") carrying the rebased
+    ``worker.recv``/``worker.segment``/``worker.reply`` spans, and one
+    ``clock.align`` instant per worker.
+    """
+    spans = sorted(
+        (e for e in events if e.get("ph") == "X"), key=lambda e: e["ts"]
+    )
+    worker_pids = {
+        e["pid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M"
+        and e.get("name") == "process_name"
+        and str(e.get("args", {}).get("name", "")).startswith("worker ")
+    }
+    if not worker_pids:
+        return (
+            "no worker tracks in trace — not a merged cluster trace "
+            "(cpu-cluster backend with --trace), or no worker shipped "
+            "telemetry"
+        )
+    lines: list[str] = []
+    wall = wall_span_us(spans)
+    rpc = [e for e in spans if e["name"] == "rpc.assign"]
+    wseg = [e for e in spans if e["name"] == "worker.segment"]
+    lines.append(
+        f"cluster timeline: {len(worker_pids)} workers, {len(rpc)} "
+        f"rpc.assign round-trips over {wall / 1e3:.1f} ms"
+    )
+
+    # --- per-worker utilization / idle --------------------------------------
+    lines.append("")
+    lines.append("per-worker utilization (busy = worker.segment time, "
+                 "idle = worker.recv wait):")
+    lines.append(
+        f"  {'worker':<10} {'segs':>5} {'busy ms':>10} {'util %':>7} "
+        f"{'idle ms':>10} {'idle %':>7} {'reply ms':>9}"
+    )
+    per_worker: dict[int, dict] = {}
+    for pid in worker_pids:
+        rows = [e for e in spans if e["pid"] == pid]
+        busy = sum(e["dur"] for e in rows if e["name"] == "worker.segment")
+        idle = sum(e["dur"] for e in rows if e["name"] == "worker.recv")
+        reply = sum(e["dur"] for e in rows if e["name"] == "worker.reply")
+        segs = [e for e in rows if e["name"] == "worker.segment"]
+        per_worker[pid] = {
+            "busy": busy, "idle": idle, "segs": segs,
+            "max_seg": max((e["dur"] for e in segs), default=0.0),
+        }
+        lines.append(
+            f"  {worker_pids[pid]:<10} {len(segs):>5} {busy / 1e3:>10.3f} "
+            f"{100 * busy / wall if wall else 0:>6.1f}% "
+            f"{idle / 1e3:>10.3f} "
+            f"{100 * idle / wall if wall else 0:>6.1f}% {reply / 1e3:>9.3f}"
+        )
+
+    # --- rpc-wait vs compute split ------------------------------------------
+    # correlate by trace context: each rpc.assign and the worker.segment
+    # of the same attempt share args.ctx
+    seg_by_ctx = {
+        e["args"]["ctx"]: e
+        for e in wseg
+        if e.get("args", {}).get("ctx")
+    }
+    corr = nested = 0
+    rpc_total = seg_total = 0.0
+    for r in rpc:
+        rpc_total += r["dur"]
+        w = seg_by_ctx.get(r.get("args", {}).get("ctx"))
+        if w is None:
+            continue
+        corr += 1
+        seg_total += w["dur"]
+        if (
+            w["ts"] >= r["ts"]
+            and w["ts"] + w["dur"] <= r["ts"] + r["dur"]
+        ):
+            nested += 1
+    lines.append("")
+    wait = max(0.0, rpc_total - seg_total)
+    lines.append(
+        f"rpc-wait vs compute (over {corr} correlated round-trips): "
+        f"compute {seg_total / 1e3:.3f} ms "
+        f"({100 * seg_total / rpc_total if rpc_total else 0:.1f}%), "
+        f"rpc-wait {wait / 1e3:.3f} ms "
+        f"({100 * wait / rpc_total if rpc_total else 0:.1f}%)"
+    )
+    lines.append(
+        f"correlation: {corr}/{len(rpc)} rpc.assign spans have a "
+        f"worker.segment child; nested after rebase: {nested}/{corr} "
+        f"({100 * nested / corr if corr else 0:.1f}%)"
+    )
+
+    # --- straggler ranking ---------------------------------------------------
+    lines.append("")
+    lines.append("straggler ranking (by slowest single segment):")
+    ranked = sorted(
+        per_worker.items(), key=lambda kv: -kv[1]["max_seg"]
+    )[:top]
+    for pid, w in ranked:
+        n = len(w["segs"])
+        mean = w["busy"] / n if n else 0.0
+        lines.append(
+            f"  {worker_pids[pid]:<10} max {w['max_seg'] / 1e3:>9.3f} ms  "
+            f"mean {mean / 1e3:>9.3f} ms  busy {w['busy'] / 1e3:>9.3f} ms"
+        )
+
+    # --- clock alignment -----------------------------------------------------
+    lines.append("")
+    aligns = [e for e in events if e.get("name") == "clock.align"]
+    if aligns:
+        lines.append("clock alignment (NTP-style min-RTT estimate, "
+                     "error bound = RTT/2):")
+        max_err = None
+        total_dropped = 0
+        for e in sorted(aligns, key=lambda e: e["args"].get("worker", 0)):
+            a = e["args"]
+            total_dropped += a.get("dropped", 0)
+            if "offset_s" in a:
+                max_err = (
+                    a["err_s"] if max_err is None
+                    else max(max_err, a["err_s"])
+                )
+                lines.append(
+                    f"  worker {a['worker']}: offset "
+                    f"{a['offset_s'] * 1e3:+.3f} ms, rtt "
+                    f"{a['rtt_s'] * 1e3:.3f} ms, err <= "
+                    f"{a['err_s'] * 1e6:.0f} us "
+                    f"({a.get('samples', 0)} samples, "
+                    f"{a.get('dropped', 0)} events dropped)"
+                )
+            else:
+                lines.append(
+                    f"  worker {a['worker']}: no alignment sample "
+                    f"(events merged unrebased)"
+                )
+        if max_err is not None:
+            lines.append(
+                f"  max clock-alignment error: {max_err * 1e6:.0f} us"
+            )
+        if total_dropped:
+            lines.append(
+                f"  WARNING: {total_dropped} worker trace events dropped "
+                "by the ship ring (raise SIEVE_TELEMETRY_RING)"
+            )
+    else:
+        lines.append("clock alignment: no clock.align events in trace")
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         description="summarize a sieve --trace file (Chrome trace-event "
@@ -126,7 +290,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("trace_file")
     p.add_argument("--top", type=int, default=10,
                    help="how many slowest spans to list")
+    p.add_argument("--cluster", action="store_true",
+                   help="distributed view of a merged cpu-cluster trace: "
+                        "per-worker utilization, rpc-wait vs compute, "
+                        "stragglers, clock-alignment error")
     args = p.parse_args(argv)
+    if args.cluster:
+        print(cluster_report(load_all(args.trace_file), top=args.top))
+        return 0
     spans = load_events(args.trace_file)
     if not spans:
         print("no span events in trace", file=sys.stderr)
