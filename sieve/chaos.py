@@ -1,0 +1,152 @@
+"""Composable fault-injection plane for the cpu-cluster backend.
+
+Generalizes the one-shot ``--chaos-kill-worker k@s`` hook into a
+*schedule* of directives (ISSUE 6 tentpole 4), e.g.::
+
+    --chaos "kill:1@s4,stall:2@s7:3.0,drop_hb:any@s9,disconnect:0@s2"
+
+Grammar — comma-separated items, each ``kind:worker@s<seg>[:param]``:
+
+* ``kill:w@sK``           worker hard-exits (``os._exit``) on receiving
+                          segment K — the section 5.3 crash injection.
+* ``stall:w@sK:secs``     worker finishes segment K, then goes *silent*
+                          for ``secs`` (default 1.0) before sending the
+                          reply — a stalled-but-alive straggler whose
+                          heartbeats have already stopped. Exercises the
+                          adaptive silence deadline.
+* ``drop_hb:w@sK``        worker suppresses its progress heartbeats for
+                          segment K (clock alignment and deadline refresh
+                          lose that sample stream).
+* ``disconnect:w@sK:secs`` worker drops the TCP connection ``secs``
+                          (default 0.05) after segment K's assignment is
+                          in flight, then reconnects with backoff — a
+                          mid-segment network blip.
+
+``worker`` is an integer id, or ``any``/``*`` for whichever worker draws
+the segment (the pull model makes a specific id probabilistic, ``any``
+deterministic). Directives are transported to the worker inside the
+``assign`` message, so tests and tools/chaos_smoke.py compose multi-fault
+scenarios purely from config.
+
+Directives are consumed when taken (one-shot): a reassigned segment's
+replacement owner runs fault-free, which is what makes every composed
+scenario terminate deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+ANY_WORKER = -1  # "any@sK": whichever worker draws segment K
+KINDS = ("kill", "stall", "drop_hb", "disconnect")
+# default param (seconds) for kinds that take one; None = no param
+DEFAULT_PARAM: dict[str, float | None] = {
+    "kill": None,
+    "stall": 1.0,
+    "drop_hb": None,
+    "disconnect": 0.05,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosDirective:
+    kind: str
+    worker: int  # ANY_WORKER matches every worker
+    seg_id: int
+    param: float | None = None
+
+    def matches(self, worker_id: int, seg_id: int) -> bool:
+        return self.seg_id == seg_id and self.worker in (ANY_WORKER, worker_id)
+
+    def to_wire(self) -> dict:
+        """The per-assignment payload shipped to the worker."""
+        return {"kind": self.kind, "param": self.param}
+
+
+def parse_chaos(spec: str) -> list[ChaosDirective]:
+    """Parse a chaos schedule string; raises ValueError with the offending
+    item on bad grammar so config construction fails early."""
+    out: list[ChaosDirective] = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        parts = item.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"chaos item {item!r}: expected kind:worker@s<seg>[:param]"
+            )
+        kind, target = parts[0], parts[1]
+        if kind not in KINDS:
+            raise ValueError(
+                f"chaos item {item!r}: unknown kind {kind!r} "
+                f"(one of {', '.join(KINDS)})"
+            )
+        if "@" not in target:
+            raise ValueError(
+                f"chaos item {item!r}: target must be worker@s<seg>"
+            )
+        who, seg = target.split("@", 1)
+        if who in ("any", "*"):
+            worker = ANY_WORKER
+        else:
+            try:
+                worker = int(who)
+            except ValueError:
+                raise ValueError(
+                    f"chaos item {item!r}: worker must be an integer id, "
+                    f"'any', or '*', got {who!r}"
+                ) from None
+            if worker < 0:
+                raise ValueError(
+                    f"chaos item {item!r}: worker id must be >= 0"
+                )
+        if not seg.startswith("s") or not seg[1:].isdigit():
+            raise ValueError(
+                f"chaos item {item!r}: segment must be written s<id>, "
+                f"got {seg!r}"
+            )
+        seg_id = int(seg[1:])
+        if len(parts) == 3:
+            if kind == "kill":
+                raise ValueError(f"chaos item {item!r}: kill takes no param")
+            try:
+                param = float(parts[2])
+            except ValueError:
+                raise ValueError(
+                    f"chaos item {item!r}: param must be a number (seconds)"
+                ) from None
+            if param < 0:
+                raise ValueError(f"chaos item {item!r}: param must be >= 0")
+        else:
+            param = DEFAULT_PARAM[kind]
+        out.append(ChaosDirective(kind, worker, seg_id, param))
+    return out
+
+
+class ChaosSchedule:
+    """Coordinator-side one-shot schedule.
+
+    ``take(worker, seg)`` atomically removes and returns every directive
+    matching the assignment, as wire dicts — so a segment requeued after
+    an injected fault finds a fault-free replacement owner.
+    """
+
+    def __init__(self, directives: list[ChaosDirective]):
+        self._lock = threading.Lock()
+        self._pending = list(directives)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def take(self, worker_id: int, seg_id: int) -> list[dict]:
+        with self._lock:
+            hit = [d for d in self._pending if d.matches(worker_id, seg_id)]
+            if hit:
+                taken = set(map(id, hit))
+                self._pending = [
+                    d for d in self._pending if id(d) not in taken
+                ]
+        return [d.to_wire() for d in hit]

@@ -1,15 +1,44 @@
-"""Checkpoint / resume ledger (SURVEY.md section 5.4).
+"""Checkpoint / resume ledger (SURVEY.md section 5.4; ISSUE 6 tentpole 3).
 
-A JSON ledger ``{config_hash, completed: {seg_id: SegmentResult}}`` written
-atomically after each completed segment (CPU path) or round (TPU path).
+Format (version 2)::
+
+    {"version": 2, "config_hash": h, "checksum": c,
+     "completed": {seg_id: SegmentResult}}
+
+``checksum`` is a truncated sha256 over the canonical
+``{config_hash, completed}`` payload, verified on every open — so bit rot
+is *detected* instead of silently merged. Version-1 files (no
+``version``/``checksum``) written by older builds still load.
+
+Durability: every flush writes a temp file, fsyncs it, atomically renames
+it over the ledger, and fsyncs the directory (``SIEVE_LEDGER_FSYNC=0``
+opts out) — a host crash can't leave a torn checkpoint, only the previous
+complete one.
+
+Corruption handling on open:
+
+* unparseable / truncated file — the damaged file is quarantined to
+  ``<ledger>.quarantined`` and salvaged entry-by-entry: any complete
+  ``SegmentResult`` object whose fields pass :meth:`SegmentResult.is_sane`
+  is recovered, provided the embedded ``config_hash`` still matches the
+  current run. A clean checksummed ledger is rewritten immediately and
+  ``Ledger.salvaged``/``Ledger.quarantined`` let the caller emit a
+  ``ledger_salvaged`` event. If nothing is salvageable, :class:`LedgerCorrupt`
+  names the quarantined file and spells out the ``--resume`` implications.
+* parseable but checksum-mismatched — silent corruption with no way to
+  tell *which* entry is bad: quarantined, never salvaged,
+  :class:`LedgerCorrupt` raised.
+
 ``--resume`` replays the merge over ledger + remaining segments; a
 config-hash mismatch refuses to resume (the math would differ).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import re
 import tempfile
 from pathlib import Path
 from typing import TYPE_CHECKING
@@ -20,10 +49,46 @@ if TYPE_CHECKING:
     from sieve.config import SieveConfig
 
 LEDGER_NAME = "sieve_ledger.json"
+LEDGER_VERSION = 2
+
+# completed-dict entries: '"<seg_id>": {flat object}' — SegmentResult
+# serializations are flat, so a non-greedy brace match per entry is exact
+_ENTRY_RE = re.compile(r'"(\d+)"\s*:\s*(\{[^{}]*\})')
+_HASH_RE = re.compile(r'"config_hash"\s*:\s*"([0-9a-f]+)"')
 
 
 class LedgerMismatch(RuntimeError):
     pass
+
+
+class LedgerCorrupt(LedgerMismatch):
+    """The ledger file failed parse or checksum; the damaged file has been
+    quarantined (path in the message) and nothing could be salvaged."""
+
+
+def _payload_checksum(config_hash: str, completed: dict[str, dict]) -> str:
+    blob = json.dumps(
+        {"config_hash": config_hash, "completed": completed}, sort_keys=True
+    ).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def _fsync_enabled() -> bool:
+    return os.environ.get("SIEVE_LEDGER_FSYNC", "1") != "0"
+
+
+def _salvage_entries(text: str) -> dict[int, dict]:
+    """Recover complete, sane SegmentResult entries from corrupt ledger
+    bytes (truncation keeps every fully-written entry intact)."""
+    out: dict[int, dict] = {}
+    for m in _ENTRY_RE.finditer(text):
+        try:
+            res = SegmentResult.from_dict(json.loads(m.group(2)))
+        except (ValueError, KeyError, TypeError):
+            continue
+        if res.is_sane():
+            out[int(m.group(1))] = res.to_dict()
+    return out
 
 
 class Ledger:
@@ -31,6 +96,10 @@ class Ledger:
         self.path = path
         self.config_hash = config_hash
         self._entries = entries
+        # salvage provenance (set by open() when a corrupt file was
+        # recovered) — callers emit the ledger_salvaged metrics event
+        self.salvaged = 0
+        self.quarantined: str | None = None
 
     @classmethod
     def open(cls, config: "SieveConfig") -> "Ledger":
@@ -38,18 +107,91 @@ class Ledger:
         path = Path(config.checkpoint_dir) / LEDGER_NAME
         chash = config.config_hash()
         entries: dict[int, dict] = {}
+        salvaged = 0
+        quarantined: Path | None = None
         if path.exists():
-            data = json.loads(path.read_text())
-            if data.get("config_hash") != chash:
-                raise LedgerMismatch(
-                    f"ledger at {path} was written for config_hash="
-                    f"{data.get('config_hash')}, current run is {chash}; "
-                    "refusing to mix results (delete the ledger or match the config)"
+            text = path.read_text()
+            data, corrupt = cls._parse(text)
+            if data is not None:
+                if data.get("config_hash") != chash:
+                    raise LedgerMismatch(
+                        f"ledger at {path} was written for config_hash="
+                        f"{data.get('config_hash')}, current run is {chash}; "
+                        "refusing to mix results (delete the ledger or match "
+                        "the config)"
+                    )
+                if int(data.get("version", 1)) > LEDGER_VERSION:
+                    raise LedgerMismatch(
+                        f"ledger at {path} has version {data.get('version')} "
+                        f"(this build writes {LEDGER_VERSION}); refusing to "
+                        "rewrite a newer format"
+                    )
+                entries = {
+                    int(k): v for k, v in data.get("completed", {}).items()
+                }
+            else:
+                quarantined, entries = cls._quarantine_and_salvage(
+                    path, text, chash, corrupt
                 )
-            entries = {int(k): v for k, v in data.get("completed", {}).items()}
+                salvaged = len(entries)
         else:
             path.parent.mkdir(parents=True, exist_ok=True)
-        return cls(path, chash, entries)
+        ledger = cls(path, chash, entries)
+        if salvaged:
+            ledger.salvaged = salvaged
+            ledger.quarantined = str(quarantined)
+            ledger._flush()  # rewrite a clean, checksummed ledger now
+        return ledger
+
+    @staticmethod
+    def _parse(text: str) -> tuple[dict | None, str]:
+        """(payload, "") when intact; (None, reason) when corrupt.
+
+        reason "truncated" = unparseable bytes (salvageable per entry);
+        reason "checksum" = parseable but failing its own checksum
+        (silent corruption — not salvageable)."""
+        try:
+            data = json.loads(text)
+        except ValueError:
+            return None, "truncated"
+        if not isinstance(data, dict) or "config_hash" not in data:
+            return None, "truncated"
+        want = data.get("checksum")
+        if want is not None and want != _payload_checksum(
+            data.get("config_hash"), data.get("completed") or {}
+        ):
+            return None, "checksum"
+        return data, ""
+
+    @classmethod
+    def _quarantine_and_salvage(
+        cls, path: Path, text: str, chash: str, reason: str
+    ) -> tuple[Path, dict[int, dict]]:
+        qpath = path.with_name(path.name + ".quarantined")
+        os.replace(path, qpath)
+        entries: dict[int, dict] = {}
+        m = _HASH_RE.search(text)
+        if reason == "truncated" and m and m.group(1) == chash:
+            entries = _salvage_entries(text)
+        if entries:
+            return qpath, entries
+        detail = (
+            "its checksum does not match its payload (silent corruption; "
+            "per-entry salvage is unsafe)"
+            if reason == "checksum"
+            else "it is truncated or unparseable and no complete entry "
+            "matching this run's config could be salvaged"
+            if m is None or m.group(1) == chash
+            else f"its recovered config_hash {m.group(1)} does not match "
+            f"this run's {chash}"
+        )
+        raise LedgerCorrupt(
+            f"ledger at {path} is corrupt: {detail}. The damaged file was "
+            f"quarantined to {qpath}; --resume has no completed segments to "
+            f"restore from it. Rerun without --resume to recompute from "
+            f"scratch, or restore a known-good ledger to {path} "
+            f"(delete {qpath} once investigated)."
+        )
 
     def completed(self) -> dict[int, SegmentResult]:
         return {k: SegmentResult.from_dict(v) for k, v in self._entries.items()}
@@ -61,15 +203,30 @@ class Ledger:
         self._flush()
 
     def _flush(self) -> None:
+        completed = {str(k): v for k, v in self._entries.items()}
         payload = {
+            "version": LEDGER_VERSION,
             "config_hash": self.config_hash,
-            "completed": {str(k): v for k, v in self._entries.items()},
+            "checksum": _payload_checksum(self.config_hash, completed),
+            "completed": completed,
         }
         fd, tmp = tempfile.mkstemp(dir=self.path.parent, prefix=".ledger.")
         try:
             with os.fdopen(fd, "w") as f:
                 json.dump(payload, f)
+                if _fsync_enabled():
+                    f.flush()
+                    os.fsync(f.fileno())
             os.replace(tmp, self.path)  # atomic on POSIX
+            if _fsync_enabled():
+                # fsync the directory so the rename itself is durable: a
+                # crash after this point replays the NEW ledger, before it
+                # the previous complete one — never a torn file
+                dfd = os.open(self.path.parent, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)

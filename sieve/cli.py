@@ -82,9 +82,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="suppress per-segment stderr lines (the run "
                         "summary and robustness events still print)")
     p.add_argument("--json", action="store_true", dest="json_output")
+    p.add_argument("--chaos", default=None,
+                   help="composable fault-injection schedule, e.g. "
+                        "'kill:1@s4,stall:2@s7:3.0,drop_hb:any@s9,"
+                        "disconnect:0@s2' (kind:worker@s<seg>[:param]; "
+                        "see sieve/chaos.py)")
     p.add_argument("--chaos-kill-worker", default=None, dest="chaos_kill",
                    help="fault injection: 'k@s' kills worker k at segment s "
-                        "('any@s': whichever worker draws segment s)")
+                        "('any@s': whichever worker draws segment s); "
+                        "legacy shorthand for --chaos 'kill:k@s<s>'")
     p.add_argument("--role", choices=("auto", "coordinator", "worker"), default="auto",
                    help="cpu-cluster role (worker processes connect to --coordinator-addr)")
     p.add_argument("--coordinator-addr", default="127.0.0.1:7621")
@@ -115,6 +121,7 @@ def config_from_args(args: argparse.Namespace) -> SieveConfig:
         metrics_file=args.metrics_file,
         quiet=args.quiet,
         json_output=args.json_output,
+        chaos=args.chaos,
         chaos_kill=args.chaos_kill,
         coordinator_addr=args.coordinator_addr,
     )

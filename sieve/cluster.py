@@ -32,25 +32,51 @@ Telemetry rides the terminal ``done``/``error`` reply (bounded
 drop-oldest ring, see sieve/worker.py), so a worker that dies
 mid-assignment loses only its unshipped spans.
 
-Fault injection (section 5.3): ``--chaos-kill-worker k@s`` makes worker k
-hard-exit (os._exit) when it receives segment s — exercising detection,
-reassignment, and exact-parity recovery in tests.
+Elastic membership (ISSUE 6): the coordinator keeps accepting ``hello``s
+for the whole run, so workers may join late or rejoin after a drop — each
+connection gets the config/seeds handshake and its own serving thread,
+and departures drain (requeue + ``worker_left``) without aborting the
+run. External workers survive coordinator restarts and network blips by
+reconnecting with capped exponential backoff + jitter, and every socket
+read is bounded so a dead peer can never park a worker in ``recv``
+forever.
+
+Adaptive deadlines: the per-assignment *silence* deadline (how long a
+worker may go without any message before it is declared dead) is derived
+from live estimates — p95 observed assignment duration × slack and the
+worker's min-RTT from the PR 5 clock-alignment samples — floored at the
+static ``SIEVE_CLUSTER_DEADLINE_S`` constant and at a few heartbeat
+intervals. Heartbeats keep refreshing it, so a slow-but-alive worker is
+never falsely declared dead, while operators can drop the static floor
+far below the old 60 s for fast dead-worker detection. Every effective
+change emits an auditable ``deadline_adjusted`` event.
+
+Fault injection (section 5.3): ``--chaos`` composes a schedule of kills,
+reply stalls, heartbeat suppression, and mid-segment disconnects
+(sieve/chaos.py); ``--chaos-kill-worker k@s`` remains as the legacy
+one-shot kill spelling. Directives ride the ``assign`` message and are
+consumed at assign time, so reassigned segments run fault-free.
 """
 
 from __future__ import annotations
 
+import collections
 import json
+import math
 import os
 import queue
+import random
 import socket
 import struct
 import subprocess
 import sys
 import threading
+import time
 
 import numpy as np
 
 from sieve import trace
+from sieve.chaos import ANY_WORKER, ChaosSchedule
 from sieve.checkpoint import Ledger
 from sieve.config import SieveConfig
 from sieve.coordinator import SieveResult, merge_results
@@ -60,8 +86,22 @@ from sieve.segments import plan_segments, validate_plan
 from sieve.worker import SegmentResult
 
 HEARTBEAT_S = 1.0
+# import-time snapshot kept for backwards compatibility; the live floor
+# is _base_deadline_s(), re-read per call so runs/tests can tune it
 DEADLINE_S = float(os.environ.get("SIEVE_CLUSTER_DEADLINE_S", "60"))
-ANY_WORKER = -1  # chaos_kill "any@s": whichever worker draws segment s
+_HANDSHAKE_TIMEOUT_S = 30.0
+
+
+def _base_deadline_s() -> float:
+    """Static silence-deadline floor (the pre-adaptive constant)."""
+    return float(os.environ.get("SIEVE_CLUSTER_DEADLINE_S", "60"))
+
+
+def _worker_recv_timeout_s() -> float:
+    """Worker-side bound on any single socket read: an idle worker whose
+    coordinator went silent reconnects (or gives up) instead of blocking
+    in recv forever."""
+    return float(os.environ.get("SIEVE_WORKER_RECV_TIMEOUT_S", "30"))
 
 
 # --- framing -----------------------------------------------------------------
@@ -102,110 +142,221 @@ def _parse_addr(addr: str) -> tuple[str, int]:
 
 
 def serve_worker(config: SieveConfig, worker_id: int | None = None) -> None:
-    """Connect to the coordinator and process assignments until shutdown."""
+    """Worker main: connect (and reconnect) to the coordinator, process
+    assignments until an explicit shutdown.
+
+    Elastic membership (ISSUE 6): any connection loss — a refused connect
+    while the coordinator is still binding, a coordinator restart, a
+    chaos-injected mid-segment drop — is retried with capped exponential
+    backoff + jitter (``SIEVE_WORKER_BACKOFF_S`` base, doubled per try up
+    to ``SIEVE_WORKER_BACKOFF_CAP_S``, at most
+    ``SIEVE_WORKER_RECONNECT_MAX`` consecutive failures). Exhausting the
+    budget logs to stderr and returns cleanly instead of dying on a
+    traceback. Every socket read is bounded by
+    ``SIEVE_WORKER_RECV_TIMEOUT_S`` so a dead coordinator can never park
+    the worker in ``recv`` forever.
+    """
     if worker_id is None:
         worker_id = int(os.environ.get("SIEVE_WORKER_ID", "0"))
     host, port = _parse_addr(config.coordinator_addr)
-    sock = socket.create_connection((host, port), timeout=30)
-    sock.settimeout(None)
-    send_msg(sock, {"type": "hello", "worker_id": worker_id})
-    msg = recv_msg(sock)
-    assert msg and msg["type"] == "config", f"bad handshake: {msg}"
-    run_cfg = SieveConfig.from_dict(msg["config"])
-    seeds = np.asarray(msg["seeds"], dtype=np.int64)
+    base = float(os.environ.get("SIEVE_WORKER_BACKOFF_S", "0.1"))
+    cap = float(os.environ.get("SIEVE_WORKER_BACKOFF_CAP_S", "5.0"))
+    max_tries = int(os.environ.get("SIEVE_WORKER_RECONNECT_MAX", "6"))
 
-    from sieve.backends import make_worker
-    from sieve.worker import telemetry_payload, telemetry_start
+    from sieve.worker import telemetry_start
 
-    compute_cfg = SieveConfig.from_dict(
-        {**run_cfg.to_dict(), "backend": _worker_backend()}
-    )
-    worker = make_worker(compute_cfg)
-    shipping = telemetry_start()
-    reg = registry()
+    session = _WorkerSession(config, worker_id, shipping=telemetry_start())
+    tries = 0
     try:
         while True:
-            t_wait0 = trace.now_s()
-            msg = recv_msg(sock)
-            t_recv = trace.now_s()
-            if msg is None or msg["type"] == "shutdown":
-                return
-            assert msg["type"] == "assign", msg
-            if msg.get("chaos_die"):
-                os._exit(17)  # simulated hard crash, no cleanup
-            ctx = msg.get("ctx")
-            # idle-wait + message receive: the worker-side view of "no
-            # work assigned" that per-host idle accounting needs
-            trace.add_span(
-                "worker.recv", t_wait0, t_recv - t_wait0,
-                seg=msg["seg_id"], worker=worker_id, ctx=ctx,
-            )
-            reg.histogram("worker.recv_wait_ms").observe(
-                round((t_recv - t_wait0) * 1000, 3)
-            )
-            result: list[SegmentResult] = []
-            failure: list[str] = []
-
-            def _work(m=msg, ctx=ctx):
-                try:
-                    if os.environ.get("SIEVE_CHAOS_RAISE") == str(m["seg_id"]):
-                        raise RuntimeError("chaos: injected segment failure")
-                    with trace.span(
-                        "worker.segment",
-                        seg=m["seg_id"], worker=worker_id, ctx=ctx,
-                    ):
-                        result.append(
-                            worker.process_segment(
-                                m["lo"], m["hi"], seeds, m["seg_id"]
-                            )
-                        )
-                except Exception as e:  # report, don't die: the coordinator
-                    import traceback     # decides whether to retry or abort
-
-                    failure.append(f"{e!r}\n{traceback.format_exc()}")
-
-            t = threading.Thread(target=_work, daemon=True)
-            t.start()
-            while t.is_alive():
-                t.join(HEARTBEAT_S)
-                if t.is_alive():
-                    # t_recv/t_hb give the coordinator a payload-free NTP
-                    # sample mid-assignment (long segments refresh the
-                    # clock offset without waiting for the reply)
-                    send_msg(sock, {
-                        "type": "progress", "seg_id": msg["seg_id"],
-                        "t_recv": t_recv, "t_hb": trace.now_s(),
-                    })
-            if failure:
-                reg.counter("worker.segment_errors").inc()
-                reply = {
-                    "type": "error", "seg_id": msg["seg_id"],
-                    "error": failure[0],
-                }
-            else:
-                res = result[0]
-                reg.counter("worker.segments_done").inc()
-                reg.histogram("worker.segment_ms").observe(
-                    round(res.elapsed_s * 1000, 3)
+            err: BaseException | None = None
+            sock: socket.socket | None = None
+            try:
+                sock = socket.create_connection((host, port), timeout=10)
+                sock.settimeout(_worker_recv_timeout_s())
+                if session.serve(sock):
+                    return  # explicit shutdown from the coordinator
+                err = ConnectionError("coordinator closed the connection")
+            except (ConnectionError, OSError) as e:
+                err = e
+            finally:
+                if sock is not None:
+                    sock.close()
+            if session.handshaken:
+                tries = 0  # a fresh outage after a healthy session
+                session.handshaken = False
+            tries += 1
+            if tries > max_tries:
+                print(
+                    f"sieve worker {worker_id}: giving up after "
+                    f"{tries - 1} reconnect attempts: {err!r}",
+                    file=sys.stderr, flush=True,
                 )
-                reply = {"type": "done", "result": res.to_dict()}
-            reply["ctx"] = ctx
-            reply["t_recv"] = t_recv
-            if shipping:
-                # piggyback: this drains worker.recv + worker.segment of
-                # THIS attempt (plus any earlier worker.reply) — a span
-                # that closes after the send ships on the next reply
-                reply["telemetry"] = telemetry_payload(worker_id)
-            t_reply = trace.now_s()
-            reply["t_reply"] = t_reply
-            send_msg(sock, reply)
-            trace.add_span(
-                "worker.reply", t_reply, trace.now_s() - t_reply,
-                seg=msg["seg_id"], worker=worker_id, ctx=ctx,
-            )
+                return
+            # capped exponential backoff + jitter: a fleet retrying a
+            # restarted coordinator must not reconnect in lockstep
+            delay = min(cap, base * (2 ** (tries - 1)))
+            time.sleep(delay * (0.5 + random.random()))
     finally:
-        worker.close()
-        sock.close()
+        session.close()
+
+
+class _WorkerSession:
+    """Worker-side state that survives reconnects: the compute backend,
+    the telemetry-shipping flag, and the last handshake."""
+
+    def __init__(self, config: SieveConfig, worker_id: int, shipping: bool):
+        self.config = config
+        self.worker_id = worker_id
+        self.shipping = shipping
+        self.worker = None  # compute backend, created on first config
+        self.seeds: np.ndarray | None = None
+        self.handshaken = False
+
+    def close(self) -> None:
+        if self.worker is not None:
+            self.worker.close()
+
+    def serve(self, sock: socket.socket) -> bool:
+        """One connected session; True means explicit shutdown (exit)."""
+        from sieve.backends import make_worker
+
+        send_msg(sock, {"type": "hello", "worker_id": self.worker_id})
+        try:
+            msg = recv_msg(sock)
+        except socket.timeout:
+            raise ConnectionError("coordinator silent during handshake")
+        if msg is None:
+            raise ConnectionError("coordinator closed during handshake")
+        if msg["type"] == "shutdown":
+            return True
+        assert msg["type"] == "config", f"bad handshake: {msg}"
+        self.handshaken = True
+        run_cfg = SieveConfig.from_dict(msg["config"])
+        self.seeds = np.asarray(msg["seeds"], dtype=np.int64)
+        if self.worker is None:
+            compute_cfg = SieveConfig.from_dict(
+                {**run_cfg.to_dict(), "backend": _worker_backend()}
+            )
+            self.worker = make_worker(compute_cfg)
+        while True:
+            t_wait0 = trace.now_s()
+            try:
+                msg = recv_msg(sock)
+            except socket.timeout:
+                # bounded recv: a silent coordinator (dead host, wedged
+                # process) can't block us forever — reconnect or give up
+                raise ConnectionError(
+                    f"no traffic from coordinator for "
+                    f"{_worker_recv_timeout_s():.0f}s"
+                )
+            t_recv = trace.now_s()
+            if msg is None:
+                raise ConnectionError("coordinator closed the connection")
+            if msg["type"] == "shutdown":
+                return True
+            assert msg["type"] == "assign", msg
+            self._assignment(sock, msg, t_wait0, t_recv)
+
+    def _assignment(
+        self, sock: socket.socket, msg: dict, t_wait0: float, t_recv: float
+    ) -> None:
+        worker_id = self.worker_id
+        chaos = msg.get("chaos") or []
+        if msg.get("chaos_die") or any(c["kind"] == "kill" for c in chaos):
+            os._exit(17)  # simulated hard crash, no cleanup
+        ctx = msg.get("ctx")
+        # idle-wait + message receive: the worker-side view of "no
+        # work assigned" that per-host idle accounting needs
+        trace.add_span(
+            "worker.recv", t_wait0, t_recv - t_wait0,
+            seg=msg["seg_id"], worker=worker_id, ctx=ctx,
+        )
+        reg = registry()
+        reg.histogram("worker.recv_wait_ms").observe(
+            round((t_recv - t_wait0) * 1000, 3)
+        )
+        disconnect = next(
+            (c for c in chaos if c["kind"] == "disconnect"), None
+        )
+        if disconnect is not None:
+            # mid-segment network blip: the assignment is in flight, the
+            # connection drops, the coordinator requeues, we reconnect
+            time.sleep(float(disconnect.get("param") or 0.05))
+            raise ConnectionError("chaos: injected mid-segment disconnect")
+        drop_hb = any(c["kind"] == "drop_hb" for c in chaos)
+        stall_s = max(
+            (float(c.get("param") or 1.0)
+             for c in chaos if c["kind"] == "stall"),
+            default=0.0,
+        )
+        result: list[SegmentResult] = []
+        failure: list[str] = []
+
+        def _work(m=msg, ctx=ctx):
+            try:
+                if os.environ.get("SIEVE_CHAOS_RAISE") == str(m["seg_id"]):
+                    raise RuntimeError("chaos: injected segment failure")
+                with trace.span(
+                    "worker.segment",
+                    seg=m["seg_id"], worker=worker_id, ctx=ctx,
+                ):
+                    result.append(
+                        self.worker.process_segment(
+                            m["lo"], m["hi"], self.seeds, m["seg_id"]
+                        )
+                    )
+            except Exception as e:  # report, don't die: the coordinator
+                import traceback     # decides whether to retry or abort
+
+                failure.append(f"{e!r}\n{traceback.format_exc()}")
+
+        t = threading.Thread(target=_work, daemon=True)
+        t.start()
+        while t.is_alive():
+            t.join(HEARTBEAT_S)
+            if t.is_alive() and not drop_hb:
+                # t_recv/t_hb give the coordinator a payload-free NTP
+                # sample mid-assignment (long segments refresh the
+                # clock offset without waiting for the reply)
+                send_msg(sock, {
+                    "type": "progress", "seg_id": msg["seg_id"],
+                    "t_recv": t_recv, "t_hb": trace.now_s(),
+                })
+        if stall_s:
+            # silent straggle: compute is done, heartbeats have stopped,
+            # the reply is late — the adaptive silence deadline must ride
+            # this out without declaring us dead
+            time.sleep(stall_s)
+        if failure:
+            reg.counter("worker.segment_errors").inc()
+            reply = {
+                "type": "error", "seg_id": msg["seg_id"],
+                "error": failure[0],
+            }
+        else:
+            res = result[0]
+            reg.counter("worker.segments_done").inc()
+            reg.histogram("worker.segment_ms").observe(
+                round(res.elapsed_s * 1000, 3)
+            )
+            reply = {"type": "done", "result": res.to_dict()}
+        reply["ctx"] = ctx
+        reply["t_recv"] = t_recv
+        if self.shipping:
+            from sieve.worker import telemetry_payload
+
+            # piggyback: this drains worker.recv + worker.segment of
+            # THIS attempt (plus any earlier worker.reply) — a span
+            # that closes after the send ships on the next reply
+            reply["telemetry"] = telemetry_payload(worker_id)
+        t_reply = trace.now_s()
+        reply["t_reply"] = t_reply
+        send_msg(sock, reply)
+        trace.add_span(
+            "worker.reply", t_reply, trace.now_s() - t_reply,
+            seg=msg["seg_id"], worker=worker_id, ctx=ctx,
+        )
 
 
 def _worker_backend() -> str:
@@ -267,8 +418,9 @@ class _ClockAlign:
 
 class _WorkerConn(threading.Thread):
     """One coordinator-side thread per connected worker: assigns segments
-    from the shared queue, enforces the progress deadline, requeues on
-    failure."""
+    from the shared queue, enforces the adaptive silence deadline,
+    requeues on failure, and reports membership (join/leave) to the
+    cluster."""
 
     def __init__(self, cluster: "_Cluster", sock: socket.socket):
         super().__init__(daemon=True)
@@ -281,7 +433,10 @@ class _WorkerConn(threading.Thread):
         # (seg_id, lo, hi, ctx): the in-flight assignment + its trace
         # context, so failure events correlate with the timeline
         current: tuple[int, int, int, str] | None = None
+        joined = False
+        leave_reason = "run complete"
         try:
+            self.sock.settimeout(_HANDSHAKE_TIMEOUT_S)
             hello = recv_msg(self.sock)
             if not hello or hello["type"] != "hello":
                 return
@@ -294,7 +449,10 @@ class _WorkerConn(threading.Thread):
                     "seeds": cl.seeds.tolist(),
                 },
             )
-            self.sock.settimeout(DEADLINE_S)
+            # membership: a hello at any point in the run is a join — late
+            # arrivals and post-drop rejoins get the same handshake
+            cl.worker_joined(self.worker_id)
+            joined = True
             # keep serving until the whole run is done: a segment requeued by
             # another worker's failure must find a live owner even if this
             # thread saw an empty queue earlier
@@ -311,8 +469,12 @@ class _WorkerConn(threading.Thread):
                 attempt = cl.attempts.get(seg.seg_id, 0)
                 ctx = f"{cl.run_id}/{seg.seg_id}.{attempt}"
                 current = (seg.seg_id, seg.lo, seg.hi, ctx)
-                chaos = cl.chaos is not None and cl.chaos[1] == seg.seg_id \
-                    and cl.chaos[0] in (ANY_WORKER, self.worker_id)
+                chaos = cl.chaos.take(self.worker_id, seg.seg_id)
+                # adaptive silence deadline: any message (heartbeat or
+                # reply) refreshes it via settimeout-per-recv, so only a
+                # *silent* worker can breach it
+                deadline_s = cl.assign_deadline_s(self.worker_id)
+                self.sock.settimeout(deadline_s)
                 reg = registry()
                 t_assign = trace.now_s()
                 send_msg(
@@ -322,14 +484,24 @@ class _WorkerConn(threading.Thread):
                         "seg_id": seg.seg_id,
                         "lo": seg.lo,
                         "hi": seg.hi,
-                        "chaos_die": chaos,
+                        "chaos": chaos,
+                        "chaos_die": any(
+                            c["kind"] == "kill" for c in chaos
+                        ),
                         "run_id": cl.run_id,
                         "ctx": ctx,
                         "t_send": t_assign,
                     },
                 )
                 while True:
-                    msg = recv_msg(self.sock)
+                    try:
+                        msg = recv_msg(self.sock)
+                    except socket.timeout:
+                        raise ConnectionError(
+                            f"worker {self.worker_id} silent for "
+                            f"{deadline_s:.1f}s on segment {seg.seg_id} "
+                            f"(adaptive deadline)"
+                        )
                     t_now = trace.now_s()
                     inflight = t_now - t_assign
                     if msg is None:
@@ -381,22 +553,31 @@ class _WorkerConn(threading.Thread):
                             f"cluster.worker{self.worker_id}.inflight_s"
                         ).set(0.0)
                     if msg["type"] == "done":
+                        cl.observe_attempt(inflight)
                         cl.complete(SegmentResult.from_dict(msg["result"]))
                         current = None
                         break
                     if msg["type"] == "error":
+                        cl.observe_attempt(inflight)
                         cl.segment_error(current, msg["error"])
                         current = None
                         break
                     raise ConnectionError(f"unexpected message {msg['type']}")
         except (ConnectionError, OSError, socket.timeout) as e:
-            cl.worker_failed(self.worker_id, current, repr(e))
+            leave_reason = repr(e)
+            cl.worker_failed(self.worker_id, current, leave_reason)
         finally:
-            try:
-                send_msg(self.sock, {"type": "shutdown"})
-            except OSError:
-                pass
+            # only tell the worker to exit when the run is over: a worker
+            # dropped for a deadline breach (or any transport error) may
+            # still be alive and should reconnect, not terminate
+            if cl.all_done.is_set():
+                try:
+                    send_msg(self.sock, {"type": "shutdown"})
+                except OSError:
+                    pass
             self.sock.close()
+            if joined:
+                cl.worker_left(self.worker_id, leave_reason)
 
 
 class _Cluster:
@@ -421,14 +602,107 @@ class _Cluster:
         self.worker_registry: dict[int, dict] = {}   # latest snapshot
         self.tele_dropped: dict[int, int] = {}       # cumulative per worker
         self.clock: dict[int, _ClockAlign] = {}
-        self.chaos: tuple[int, int] | None = None
-        if config.chaos_kill:
-            k, s = config.chaos_kill.split("@")
-            # "any@s": kill whichever worker draws segment s — the pull
-            # model makes "k@s" probabilistic, "any@s" deterministic
-            self.chaos = (ANY_WORKER if k in ("any", "*") else int(k), int(s))
+        # composable fault-injection schedule (sieve/chaos.py); directives
+        # are consumed at assign time, so requeued segments run fault-free
+        self.chaos = ChaosSchedule(config.chaos_directives())
+        # membership + adaptive-deadline state: recent attempt durations
+        # feed the p95 term; joins/leaves feed events and the run summary
+        self._attempt_s: collections.deque = collections.deque(maxlen=256)
+        self._deadline_last: float | None = None
+        self._active_workers = 0
+        self.joins = 0
+        self.leaves = 0
         for seg in segments:
             self.queue.put(seg)
+
+    # --- membership + adaptive deadline --------------------------------------
+
+    def worker_joined(self, worker_id: int) -> None:
+        with self.lock:
+            self._active_workers += 1
+            self.joins += 1
+            active = self._active_workers
+        registry().counter("cluster.worker_joins").inc()
+        registry().gauge("cluster.active_workers").set(active)
+        self.metrics.event(
+            "worker_joined", worker=worker_id, run_id=self.run_id,
+            active=active,
+        )
+        trace.instant(
+            "cluster.worker_joined", worker=worker_id, active=active
+        )
+
+    def worker_left(self, worker_id: int, reason: str) -> None:
+        with self.lock:
+            self._active_workers -= 1
+            self.leaves += 1
+            active = self._active_workers
+        registry().counter("cluster.worker_leaves").inc()
+        registry().gauge("cluster.active_workers").set(active)
+        self.metrics.event(
+            "worker_left", worker=worker_id, reason=reason.splitlines()[0],
+            run_id=self.run_id, active=active,
+        )
+        trace.instant(
+            "cluster.worker_left", worker=worker_id, active=active
+        )
+
+    def observe_attempt(self, dur_s: float) -> None:
+        """Feed one completed assignment's duration to the deadline model."""
+        with self.lock:
+            self._attempt_s.append(dur_s)
+
+    def assign_deadline_s(self, worker_id: int) -> float:
+        """Silence deadline for one assignment to ``worker_id``.
+
+        max of: the static floor (``SIEVE_CLUSTER_DEADLINE_S``), a few
+        heartbeat intervals (``SIEVE_CLUSTER_HB_MISS``, so a worker is
+        never declared dead for missing fewer than that many heartbeats),
+        p95 observed attempt duration × ``SIEVE_CLUSTER_DEADLINE_SLACK``
+        (a straggler still sending heartbeats keeps refreshing; this term
+        covers the worst *silent* gap a healthy segment produces), and
+        8× the worker's min-RTT (transport jitter). Operators lower the
+        static floor for fast dead-worker detection; the live terms keep
+        it safe."""
+        hb_miss = float(os.environ.get("SIEVE_CLUSTER_HB_MISS", "4"))
+        slack = float(os.environ.get("SIEVE_CLUSTER_DEADLINE_SLACK", "4"))
+        with self.lock:
+            samples = sorted(self._attempt_s)
+        p95 = 0.0
+        if len(samples) >= 4:
+            p95 = samples[min(len(samples) - 1, math.ceil(0.95 * len(samples)) - 1)]
+        align = self.clock.get(worker_id)
+        rtt = align.rtt_s if align is not None and align.samples else 0.0
+        deadline = max(
+            _base_deadline_s(),
+            HEARTBEAT_S * hb_miss,
+            p95 * slack,
+            rtt * 8,
+        )
+        self._note_deadline(deadline, p95)
+        return deadline
+
+    def _note_deadline(self, deadline_s: float, p95_s: float) -> None:
+        """Audit trail: emit ``deadline_adjusted`` on the first computed
+        deadline and on every >20% change since the last emission."""
+        with self.lock:
+            prev = self._deadline_last
+            if prev is not None and abs(deadline_s - prev) <= 0.2 * prev:
+                return
+            self._deadline_last = deadline_s
+        registry().gauge("cluster.deadline_s").set(round(deadline_s, 3))
+        self.metrics.event(
+            "deadline_adjusted",
+            deadline_s=round(deadline_s, 3),
+            prev_s=round(prev, 3) if prev is not None else None,
+            p95_s=round(p95_s, 3),
+            run_id=self.run_id,
+        )
+        trace.instant(
+            "cluster.deadline_adjusted",
+            deadline_s=round(deadline_s, 3),
+            prev_s=round(prev, 3) if prev is not None else None,
+        )
 
     def ship(self, worker_id: int, payload: dict) -> None:
         """Accumulate a worker's piggybacked telemetry (raw worker-clock
@@ -505,9 +779,6 @@ class _Cluster:
         self.metrics.event(
             "reassign", seg_id=seg_id, run_id=self.run_id, ctx=ctx
         )
-        # one-shot chaos: don't re-kill the replacement owner
-        if self.chaos and self.chaos[1] == seg_id:
-            self.chaos = None
         self.queue.put(Segment(seg_id=seg_id, lo=lo, hi=hi))
 
 
@@ -598,6 +869,8 @@ def _merge_worker_telemetry(cluster: _Cluster, metrics: MetricsLogger) -> dict:
         ),
         "telemetry_events": total_events,
         "telemetry_dropped_events": total_dropped,
+        "workers_joined": cluster.joins,
+        "workers_left": cluster.leaves,
     }
     if max_err is not None:
         summary["clock_err_max_s"] = round(max_err, 6)
@@ -624,6 +897,11 @@ def run_cluster(config: SieveConfig) -> SieveResult:
     eff = SieveConfig(**{**cfg.to_dict(), "n_segments": len(segs)})
 
     ledger = Ledger.open(eff) if eff.checkpoint_dir else None
+    if ledger is not None and ledger.salvaged:
+        metrics.event(
+            "ledger_salvaged", salvaged=ledger.salvaged,
+            quarantined=ledger.quarantined,
+        )
     restored: dict[int, SegmentResult] = {}
     if ledger is not None and eff.resume:
         restored = ledger.completed()
@@ -681,7 +959,7 @@ def run_cluster(config: SieveConfig) -> SieveResult:
         # monotonic trace clock like every other timestamp (a true wall
         # deadline — e.g. a maintenance-window cutoff — would keep
         # time.time() here, with this comment saying why)
-        deadline = trace.now_s() + max(DEADLINE_S * 4, 300) + workload_s
+        deadline = trace.now_s() + max(_base_deadline_s() * 4, 300) + workload_s
         while not cluster.all_done.is_set():
             if trace.now_s() > deadline:
                 raise RuntimeError(

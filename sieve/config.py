@@ -64,7 +64,12 @@ class SieveConfig:
     metrics_file: str | None = None
     quiet: bool = False
     json_output: bool = False
-    # Fault injection hook "--chaos-kill-worker k@segment s" (section 5.3).
+    # Fault injection (section 5.3). ``chaos`` is the composable schedule
+    # ("kill:1@s4,stall:2@s7:3.0,drop_hb:any@s9,disconnect:0@s2", see
+    # sieve/chaos.py); ``chaos_kill`` is the legacy one-shot spelling
+    # "k@s" kept as shorthand for "kill:k@s<s>". Both may be given; they
+    # merge into one schedule via :meth:`chaos_directives`.
+    chaos: str | None = None
     chaos_kill: str | None = None
     # cpu-cluster transport endpoints.
     coordinator_addr: str = "127.0.0.1:7621"
@@ -92,6 +97,26 @@ class SieveConfig:
             object.__setattr__(self, "count_kind", "twins")
         elif self.count_kind in ("twins", "cousins") and not self.twins:
             object.__setattr__(self, "twins", True)
+        # parse the chaos schedule eagerly so bad grammar fails at config
+        # construction, not mid-run on a worker
+        if self.chaos or self.chaos_kill:
+            self.chaos_directives()
+
+    def chaos_directives(self) -> list:
+        """The merged fault-injection schedule (``chaos`` plus the legacy
+        ``chaos_kill`` spelling) as :class:`sieve.chaos.ChaosDirective`s."""
+        from sieve.chaos import parse_chaos
+
+        spec = self.chaos or ""
+        if self.chaos_kill:
+            if "@" not in self.chaos_kill:
+                raise ValueError(
+                    f"chaos_kill must be 'k@s', got {self.chaos_kill!r}"
+                )
+            who, seg = self.chaos_kill.split("@", 1)
+            legacy = f"kill:{who}@s{seg}"
+            spec = f"{spec},{legacy}" if spec else legacy
+        return parse_chaos(spec) if spec else []
 
     @property
     def pair_gap(self) -> int:

@@ -119,6 +119,11 @@ class Coordinator:
             segs = self.plan()
 
         ledger = Ledger.open(cfg) if cfg.checkpoint_dir else None
+        if ledger is not None and ledger.salvaged:
+            self.metrics.event(
+                "ledger_salvaged", salvaged=ledger.salvaged,
+                quarantined=ledger.quarantined,
+            )
         done: dict[int, SegmentResult] = {}
         if ledger is not None and cfg.resume:
             done = ledger.completed()

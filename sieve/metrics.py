@@ -54,6 +54,12 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "reassign": {"seg_id", "run_id", "ctx"},
     "host_prepare": {"prep_s"},
     "worker_telemetry": {"worker", "events", "dropped"},
+    # elastic membership + adaptive deadlines + ledger salvage (ISSUE 6);
+    # "active" is the live worker count after the join/leave
+    "worker_joined": {"worker", "run_id", "active"},
+    "worker_left": {"worker", "reason", "run_id", "active"},
+    "deadline_adjusted": {"deadline_s", "prev_s", "p95_s", "run_id"},
+    "ledger_salvaged": {"salvaged", "quarantined"},
 }
 
 
@@ -337,6 +343,8 @@ class MetricsLogger:
                 "telemetry_workers",
                 "telemetry_dropped_events",
                 "clock_err_max_s",
+                "workers_joined",
+                "workers_left",
             ):
                 if key in phases:
                     record[key] = phases[key]

@@ -1,0 +1,508 @@
+"""Elastic membership, adaptive deadlines, ledger integrity, and the
+composable chaos plane (ISSUE 6).
+
+Covers: the --chaos grammar and one-shot schedule; the adaptive silence
+deadline's floors and audit events; ledger v2 checksums, quarantine and
+per-entry salvage; double-completion idempotency; multi-worker failures
+in one run; mid-segment disconnect + reconnect; a stalled-but-alive
+worker surviving a tight static deadline; resume after SIGKILLing the
+coordinator; a worker joining mid-run under a four-fault composed
+schedule (the acceptance scenario); and the chaos_smoke tool as a
+tier-1 subprocess test.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from sieve import metrics, trace
+from sieve.chaos import ANY_WORKER, ChaosSchedule, parse_chaos
+from sieve.checkpoint import LEDGER_NAME, Ledger, LedgerCorrupt, LedgerMismatch
+from sieve.cluster import _Cluster, run_cluster, serve_worker
+from sieve.config import SieveConfig
+from sieve.metrics import MemorySink, MetricsLogger, validate_record
+from sieve.worker import SegmentResult
+from tests.oracles import PI, TWINS
+
+REPO = Path(__file__).parent.parent
+ADDR = "127.0.0.1:0"
+
+
+@pytest.fixture
+def memsink():
+    sink = MemorySink()
+    metrics.add_sink(sink)
+    yield sink
+    metrics.remove_sink(sink)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _cfg(**kw):
+    base = dict(
+        n=10**5,
+        backend="cpu-cluster",
+        workers=2,
+        n_segments=8,
+        twins=True,
+        quiet=True,
+        coordinator_addr=ADDR,
+    )
+    base.update(kw)
+    return SieveConfig(**base)
+
+
+def _result(seg_id=0, count=15):
+    return SegmentResult(
+        seg_id=seg_id, lo=2, hi=50, count=count, twin_count=6,
+        first_word=1, last_word=3, nbits=48, elapsed_s=0.01,
+    )
+
+
+# --- grammar + schedule ------------------------------------------------------
+
+
+def test_parse_chaos_grammar():
+    ds = parse_chaos("kill:1@s4,stall:2@s7:3.0,drop_hb:any@s9,disconnect:0@s2")
+    assert [(d.kind, d.worker, d.seg_id, d.param) for d in ds] == [
+        ("kill", 1, 4, None),
+        ("stall", 2, 7, 3.0),
+        ("drop_hb", ANY_WORKER, 9, None),
+        ("disconnect", 0, 2, 0.05),
+    ]
+    # defaults when the param is omitted
+    assert parse_chaos("stall:0@s1")[0].param == 1.0
+
+
+@pytest.mark.parametrize("bad,match", [
+    ("explode:0@s1", "unknown kind"),
+    ("kill:0", "worker@s<seg>"),
+    ("kill:x@s1", "worker must be an integer"),
+    ("kill:0@3", "segment must be written s<id>"),
+    ("kill:0@s1:2.0", "kill takes no param"),
+    ("stall:0@s1:abc", "param must be a number"),
+    ("stall:0@s1:-1", "param must be >= 0"),
+])
+def test_parse_chaos_rejects_bad(bad, match):
+    with pytest.raises(ValueError, match=match):
+        parse_chaos(bad)
+
+
+def test_schedule_take_is_one_shot():
+    sched = ChaosSchedule(parse_chaos("kill:any@s2,stall:1@s2:0.5"))
+    assert sched.take(0, 1) == []
+    got = sched.take(1, 2)  # matches both (any + worker 1)
+    assert sorted(d["kind"] for d in got) == ["kill", "stall"]
+    assert sched.take(1, 2) == []  # consumed: a reassignment runs fault-free
+    assert len(sched) == 0
+
+
+def test_config_merges_legacy_chaos_kill():
+    cfg = _cfg(chaos="stall:any@s3", chaos_kill="0@2")
+    kinds = {(d.kind, d.worker, d.seg_id) for d in cfg.chaos_directives()}
+    assert kinds == {("stall", ANY_WORKER, 3), ("kill", 0, 2)}
+
+
+def test_config_rejects_bad_chaos_eagerly():
+    with pytest.raises(ValueError, match="unknown kind"):
+        _cfg(chaos="frob:0@s1")
+
+
+# --- adaptive deadline -------------------------------------------------------
+
+
+def test_adaptive_deadline_floors_and_p95(monkeypatch, memsink):
+    monkeypatch.setenv("SIEVE_CLUSTER_DEADLINE_S", "1")
+    cfg = _cfg()
+    cl = _Cluster(cfg, None, [], MetricsLogger(cfg), None)
+    # no samples yet: the heartbeat-miss floor (4 x HEARTBEAT_S) wins over
+    # the tightened static floor
+    assert cl.assign_deadline_s(0) == pytest.approx(4.0)
+    for _ in range(8):
+        cl.observe_attempt(2.0)
+    # p95(2.0) x slack(4) = 8 now dominates
+    assert cl.assign_deadline_s(0) == pytest.approx(8.0)
+    events = [r for r in memsink.records if r["event"] == "deadline_adjusted"]
+    assert len(events) == 2  # first computation, then the >20% change
+    assert events[0]["prev_s"] is None
+    assert events[1]["prev_s"] == pytest.approx(4.0)
+    assert events[1]["deadline_s"] == pytest.approx(8.0)
+    for r in events:
+        validate_record(r)
+    # small jitter around the current deadline does not spam events
+    cl.assign_deadline_s(0)
+    assert len([r for r in memsink.records
+                if r["event"] == "deadline_adjusted"]) == 2
+
+
+def test_static_floor_still_respected(monkeypatch):
+    monkeypatch.setenv("SIEVE_CLUSTER_DEADLINE_S", "120")
+    cfg = _cfg()
+    cl = _Cluster(cfg, None, [], MetricsLogger(cfg), None)
+    assert cl.assign_deadline_s(0) == pytest.approx(120.0)
+
+
+# --- ledger integrity --------------------------------------------------------
+
+
+def test_ledger_v2_roundtrip_with_checksum(tmp_path):
+    cfg = _cfg(checkpoint_dir=str(tmp_path))
+    led = Ledger.open(cfg)
+    led.record(_result(0))
+    led.record(_result(1, count=20))
+    data = json.loads((tmp_path / LEDGER_NAME).read_text())
+    assert data["version"] == 2
+    assert "checksum" in data
+    led2 = Ledger.open(cfg)
+    assert led2.salvaged == 0
+    assert {r.seg_id for r in led2.completed().values()} == {0, 1}
+
+
+def test_ledger_v1_files_still_load(tmp_path):
+    cfg = _cfg(checkpoint_dir=str(tmp_path))
+    (tmp_path / LEDGER_NAME).write_text(json.dumps({
+        "config_hash": cfg.config_hash(),
+        "completed": {"0": _result(0).to_dict()},
+    }))
+    led = Ledger.open(cfg)
+    assert led.salvaged == 0
+    assert list(led.completed()) == [0]
+
+
+def test_ledger_truncated_quarantines_and_salvages(tmp_path, memsink):
+    cfg = _cfg(checkpoint_dir=str(tmp_path))
+    led = Ledger.open(cfg)
+    for i in range(3):
+        led.record(_result(i))
+    path = tmp_path / LEDGER_NAME
+    text = path.read_text()
+    path.write_text(text[: int(len(text) * 0.7)])  # torn write
+    led2 = Ledger.open(cfg)
+    assert led2.salvaged >= 1
+    assert led2.quarantined == str(path) + ".quarantined"
+    assert os.path.exists(led2.quarantined)
+    # the rewritten ledger is clean v2 again
+    led3 = Ledger.open(cfg)
+    assert led3.salvaged == 0
+    assert set(led3.completed()) == set(led2.completed())
+
+
+def test_ledger_unsalvageable_raises_clear_error(tmp_path):
+    cfg = _cfg(checkpoint_dir=str(tmp_path))
+    path = tmp_path / LEDGER_NAME
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("{{{garbage")
+    with pytest.raises(LedgerCorrupt, match="quarantined.*--resume") as ei:
+        Ledger.open(cfg)
+    assert isinstance(ei.value, LedgerMismatch)  # old handlers still catch
+    assert not path.exists()
+    assert os.path.exists(str(path) + ".quarantined")
+
+
+def test_ledger_checksum_mismatch_never_salvaged(tmp_path):
+    cfg = _cfg(checkpoint_dir=str(tmp_path))
+    led = Ledger.open(cfg)
+    led.record(_result(0, count=15))
+    path = tmp_path / LEDGER_NAME
+    data = json.loads(path.read_text())
+    data["completed"]["0"]["count"] = 16  # silent bit flip, stale checksum
+    path.write_text(json.dumps(data))
+    with pytest.raises(LedgerCorrupt, match="checksum"):
+        Ledger.open(cfg)
+
+
+def test_ledger_salvage_refuses_foreign_config(tmp_path):
+    cfg = _cfg(checkpoint_dir=str(tmp_path))
+    path = tmp_path / LEDGER_NAME
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(
+        '{"config_hash": "beef00000000dead", '
+        '"completed": {"0": ' + json.dumps(_result(0).to_dict()) + "}"
+    )  # truncated AND written for another run
+    with pytest.raises(LedgerCorrupt, match="does not match"):
+        Ledger.open(cfg)
+
+
+def test_double_completion_is_idempotent(tmp_path, memsink):
+    # a reassigned segment completing twice must land once in done, once
+    # in the ledger, and once in the metrics stream
+    cfg = _cfg(checkpoint_dir=str(tmp_path))
+    led = Ledger.open(cfg)
+    cl = _Cluster(cfg, None, [], MetricsLogger(cfg), led)
+    cl.n_expected = 2
+    cl.complete(_result(0))
+    cl.complete(_result(0))
+    assert len(cl.done) == 1
+    assert list(Ledger.open(cfg).completed()) == [0]
+    assert len([r for r in memsink.records if r["event"] == "segment"]) == 1
+
+
+# --- cluster fault handling --------------------------------------------------
+
+
+def test_two_workers_fail_on_different_segments(memsink):
+    # two kills on different segments in ONE run: with three workers the
+    # survivors absorb both reassignments and the counts stay exact
+    res = run_cluster(_cfg(workers=3, chaos="kill:any@s1,kill:any@s4"))
+    assert res.pi == PI[10**5]
+    assert res.twin_pairs == TWINS[10**5]
+    failed = [r for r in memsink.records if r["event"] == "worker_failed"]
+    assert len(failed) >= 2
+    joins = [r for r in memsink.records if r["event"] == "worker_joined"]
+    assert len(joins) >= 3
+    for r in memsink.records:
+        validate_record(r)
+
+
+def test_disconnect_requeues_and_worker_rejoins(monkeypatch, memsink):
+    monkeypatch.setenv("SIEVE_WORKER_BACKOFF_S", "0.05")
+    # the stall on the last segment holds the run open long enough for the
+    # disconnected worker's reconnect to land before all_done
+    res = run_cluster(_cfg(chaos="disconnect:any@s3,stall:any@s7:1.0"))
+    assert res.pi == PI[10**5]
+    assert res.twin_pairs == TWINS[10**5]
+    # the dropped worker reconnects: strictly more joins than the two
+    # initial ones, and the segment was reassigned
+    assert res.host_phases["workers_joined"] >= 3
+    assert any(r["event"] == "reassign" and r["seg_id"] == 3
+               for r in memsink.records)
+
+
+def test_stalled_but_alive_worker_not_declared_failed(monkeypatch, memsink):
+    # 1.5 s silent stall with the static floor tightened to 1 s: the
+    # heartbeat-miss floor must keep the worker alive (no worker_failed,
+    # no reassignment) and the run exact
+    monkeypatch.setenv("SIEVE_CLUSTER_DEADLINE_S", "1")
+    res = run_cluster(_cfg(chaos="stall:any@s5:1.5"))
+    assert res.pi == PI[10**5]
+    assert res.twin_pairs == TWINS[10**5]
+    assert [r for r in memsink.records if r["event"] == "worker_failed"] == []
+    assert [r for r in memsink.records if r["event"] == "reassign"] == []
+
+
+# --- worker-side robustness (satellite a) ------------------------------------
+
+
+def test_worker_gives_up_when_coordinator_never_comes_back(
+    monkeypatch, capfd
+):
+    monkeypatch.setenv("SIEVE_WORKER_RECONNECT_MAX", "2")
+    monkeypatch.setenv("SIEVE_WORKER_BACKOFF_S", "0.01")
+    monkeypatch.setenv("SIEVE_TELEMETRY_RING", "0")
+    port = _free_port()  # nothing listening
+    t0 = time.monotonic()
+    serve_worker(_cfg(coordinator_addr=f"127.0.0.1:{port}"), worker_id=7)
+    assert time.monotonic() - t0 < 10
+    assert "worker 7: giving up after 2 reconnect attempts" in (
+        capfd.readouterr().err
+    )
+
+
+def test_worker_recv_timeout_unsticks_dead_coordinator(monkeypatch, capfd):
+    # a coordinator that accepts but never speaks: the bounded recv must
+    # turn the silence into reconnect attempts instead of blocking forever
+    monkeypatch.setenv("SIEVE_WORKER_RECV_TIMEOUT_S", "0.2")
+    monkeypatch.setenv("SIEVE_WORKER_RECONNECT_MAX", "1")
+    monkeypatch.setenv("SIEVE_WORKER_BACKOFF_S", "0.01")
+    monkeypatch.setenv("SIEVE_TELEMETRY_RING", "0")
+    server = socket.socket()
+    server.bind(("127.0.0.1", 0))
+    server.listen(4)
+    addr = f"127.0.0.1:{server.getsockname()[1]}"
+    held = []
+    stop = threading.Event()
+
+    def _accept():
+        server.settimeout(0.1)
+        while not stop.is_set():
+            try:
+                held.append(server.accept()[0])  # accept, then stay silent
+            except socket.timeout:
+                continue
+
+    acceptor = threading.Thread(target=_accept, daemon=True)
+    acceptor.start()
+    try:
+        t0 = time.monotonic()
+        serve_worker(_cfg(coordinator_addr=addr), worker_id=3)
+        assert time.monotonic() - t0 < 10
+        assert "giving up" in capfd.readouterr().err
+    finally:
+        stop.set()
+        acceptor.join(timeout=2)
+        for s in held:
+            s.close()
+        server.close()
+
+
+# --- resume after coordinator SIGKILL (satellite c) --------------------------
+
+
+def test_resume_after_coordinator_sigkill(tmp_path):
+    port = _free_port()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SIEVE_WORKER_RECONNECT_MAX="3",
+        SIEVE_WORKER_BACKOFF_S="0.05",
+        PYTHONPATH=str(REPO),
+    )
+    # the stall holds segment 6 open for 30 s, guaranteeing a mid-run kill
+    # window while the other segments land in the ledger
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sieve",
+         "--n", "1e5", "--backend", "cpu-cluster", "--workers", "2",
+         "--segments", "10", "--quiet",
+         "--coordinator-addr", f"127.0.0.1:{port}",
+         "--checkpoint-dir", str(tmp_path),
+         "--chaos", "stall:any@s6:30"],
+        env=env, cwd=str(REPO),
+        # DEVNULL, not PIPE: the orphaned (still-stalling) worker inherits
+        # the pipe and would block a communicate() after the SIGKILL
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    ledger_path = tmp_path / LEDGER_NAME
+    try:
+        deadline = time.monotonic() + 60
+        completed = 0
+        while time.monotonic() < deadline:
+            if ledger_path.exists():
+                try:
+                    completed = len(
+                        json.loads(ledger_path.read_text())["completed"]
+                    )
+                except (ValueError, KeyError):
+                    completed = 0
+                if completed >= 2:
+                    break
+            time.sleep(0.05)
+        assert completed >= 2, "coordinator made no checkpoint progress"
+    finally:
+        if proc.poll() is None:
+            os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+
+    # same math-relevant config (n, segments, packing, twins) resumes on a
+    # fresh coordinator; counts must be exact, not doubled
+    res = run_cluster(SieveConfig(
+        n=10**5, backend="cpu-cluster", workers=2, n_segments=10,
+        quiet=True, coordinator_addr=ADDR,
+        checkpoint_dir=str(tmp_path), resume=True,
+    ))
+    assert res.pi == PI[10**5]
+    final = json.loads(ledger_path.read_text())
+    assert len(final["completed"]) == 10
+    assert sorted(int(k) for k in final["completed"]) == list(range(10))
+
+
+# --- acceptance: composed faults + mid-run join ------------------------------
+
+
+def test_chaos_acceptance_midrun_join(tmp_path, monkeypatch, memsink):
+    from tools.trace_report import cluster_report, load_all
+
+    monkeypatch.setenv("SIEVE_CLUSTER_NO_SPAWN", "1")
+    monkeypatch.setenv("SIEVE_WORKER_BACKOFF_S", "0.05")
+    addr = f"127.0.0.1:{_free_port()}"
+    worker = Path(__file__).parent / "multihost_worker.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def _launch(i):
+        return subprocess.Popen(
+            [sys.executable, str(worker), addr, "cluster", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(REPO),
+        )
+
+    procs = [_launch(0)]
+    stop = threading.Event()
+
+    def _joiner():
+        # worker 1 joins only after the run has made progress (first
+        # completed segment) — a genuine mid-run elastic join
+        while not stop.is_set():
+            if any(r.get("event") == "segment" for r in list(memsink.records)):
+                procs.append(_launch(1))
+                return
+            time.sleep(0.02)
+
+    joiner = threading.Thread(target=_joiner, daemon=True)
+    joiner.start()
+    tr = trace.get_tracer()
+    tr.enable()
+    try:
+        res = run_cluster(_cfg(
+            coordinator_addr=addr,
+            checkpoint_dir=str(tmp_path),
+            chaos="kill:any@s2,disconnect:any@s3,drop_hb:any@s4,"
+                  "stall:any@s5:1.5",
+        ))
+    finally:
+        tr.disable()
+        stop.set()
+        joiner.join(timeout=5)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.communicate(timeout=30)
+
+    # exact oracle parity under 4 composed faults + elastic membership
+    assert res.pi == PI[10**5]
+    assert res.twin_pairs == TWINS[10**5]
+
+    # zero double-counted ledger segments
+    data = json.loads((tmp_path / LEDGER_NAME).read_text())
+    assert sorted(int(k) for k in data["completed"]) == list(range(8))
+
+    # membership: initial join, kill leave, mid-run join, disconnect
+    # leave+rejoin — at least 3 joins and 2 leaves total
+    hp = res.host_phases
+    assert hp["workers_joined"] >= 3
+    assert hp["workers_left"] >= 2
+    kinds = {r["event"] for r in memsink.records}
+    assert {"worker_joined", "worker_left", "deadline_adjusted",
+            "worker_failed", "reassign"} <= kinds
+    # the stalled worker was NOT declared failed: every worker_failed is
+    # the kill or the disconnect, never the adaptive silence deadline
+    for r in memsink.records:
+        if r["event"] == "worker_failed":
+            assert "adaptive deadline" not in r["reason"]
+        validate_record(r)
+
+    # the merged trace timeline carries join/leave/deadline-adjust events
+    path = tmp_path / "chaos.trace.json"
+    tr.save(str(path))
+    events = load_all(str(path))
+    names = {e.get("name") for e in events}
+    assert {"cluster.worker_joined", "cluster.worker_left",
+            "cluster.deadline_adjusted"} <= names
+    text = cluster_report(events)
+    assert "membership timeline" in text
+    assert "joined" in text and "left" in text
+
+
+# --- chaos_smoke tool as tier-1 (satellite e) --------------------------------
+
+
+def test_chaos_smoke_tool(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "chaos_smoke.py"),
+         "--keep", str(tmp_path / "work")],
+        env=env, cwd=str(REPO), capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "CHAOS_SMOKE_OK" in proc.stdout

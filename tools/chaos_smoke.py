@@ -1,0 +1,106 @@
+"""Chaos smoke: a composed fault schedule plus a corrupted-ledger resume,
+asserting exact counts end to end (ISSUE 6 satellite; tier-1 via
+tests/test_chaos.py).
+
+Phase 1 runs the cpu-cluster backend under four composed faults — a
+worker kill, a mid-segment disconnect, heartbeat suppression, and a
+silent reply stall — with checkpointing on, and requires bit-exact
+pi/twin counts against a single-process cpu-numpy run of the same n.
+The stall is sized under the adaptive silence deadline's heartbeat-miss
+floor, so a stalled-but-alive worker must NOT be declared failed.
+
+Phase 2 truncates the ledger mid-file (simulating a torn write on a
+filesystem without the fsync guarantees) and re-runs with --resume: the
+damaged file must be quarantined, every complete entry salvaged, and the
+resumed run must again produce exact counts.
+
+Exit status: 0 on full parity, 1 on any mismatch (with a FAIL line).
+
+Usage: python tools/chaos_smoke.py [--n N] [--keep DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHAOS = "kill:any@s2,disconnect:any@s3,drop_hb:any@s4,stall:any@s5:1.5"
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}", flush=True)
+    sys.exit(1)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--n", type=int, default=10**5)
+    p.add_argument("--keep", default=None,
+                   help="use (and keep) this checkpoint dir instead of a "
+                        "temp dir")
+    args = p.parse_args(argv)
+
+    # tight static floor = fast dead-worker detection; the adaptive
+    # heartbeat-miss floor (4 x HEARTBEAT_S) still rides out the 1.5 s
+    # stall. Short backoff keeps the disconnect reconnect snappy.
+    os.environ.setdefault("SIEVE_CLUSTER_DEADLINE_S", "2")
+    os.environ.setdefault("SIEVE_WORKER_BACKOFF_S", "0.05")
+
+    from sieve.checkpoint import LEDGER_NAME
+    from sieve.cluster import run_cluster
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+
+    workdir = args.keep or tempfile.mkdtemp(prefix="chaos_smoke.")
+    try:
+        oracle = run_local(SieveConfig(
+            n=args.n, backend="cpu-numpy", twins=True, quiet=True,
+        ))
+        cfg = SieveConfig(
+            n=args.n, backend="cpu-cluster", workers=2, n_segments=8,
+            twins=True, quiet=True, coordinator_addr="127.0.0.1:0",
+            checkpoint_dir=workdir, chaos=CHAOS,
+        )
+
+        print(f"phase 1: composed chaos run ({CHAOS})", flush=True)
+        res = run_cluster(cfg)
+        if res.pi != oracle.pi:
+            fail(f"chaos run pi={res.pi}, oracle pi={oracle.pi}")
+        if res.twin_pairs != oracle.twin_pairs:
+            fail(f"chaos run twins={res.twin_pairs}, "
+                 f"oracle twins={oracle.twin_pairs}")
+        if len({s.seg_id for s in res.segments}) != len(res.segments):
+            fail("duplicate seg_id in merged results (ledger double-count)")
+        print(f"phase 1 OK: pi={res.pi} twins={res.twin_pairs} "
+              f"segments={len(res.segments)}", flush=True)
+
+        ledger_path = os.path.join(workdir, LEDGER_NAME)
+        text = open(ledger_path).read()
+        with open(ledger_path, "w") as f:
+            f.write(text[: int(len(text) * 0.6)])  # torn mid-file
+        print("phase 2: ledger truncated to 60%, resuming", flush=True)
+
+        res2 = run_cluster(SieveConfig(
+            **{**cfg.to_dict(), "resume": True, "chaos": None}
+        ))
+        if not os.path.exists(ledger_path + ".quarantined"):
+            fail("corrupt ledger was not quarantined")
+        if res2.pi != oracle.pi or res2.twin_pairs != oracle.twin_pairs:
+            fail(f"resumed run pi={res2.pi}/twins={res2.twin_pairs}, "
+                 f"oracle {oracle.pi}/{oracle.twin_pairs}")
+        print(f"phase 2 OK: pi={res2.pi} twins={res2.twin_pairs} "
+              f"(salvage + resume exact)", flush=True)
+        print("CHAOS_SMOKE_OK", flush=True)
+        return 0
+    finally:
+        if args.keep is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

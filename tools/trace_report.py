@@ -9,7 +9,8 @@ same file loads in Perfetto / ``chrome://tracing`` for the visual view.
 trace (coordinator + per-worker tracks, see sieve/cluster.py):
 per-worker utilization/idle, the RPC-wait vs compute split, straggler
 ranking, rpc.assign <-> worker.segment correlation/nesting after clock
-rebasing, and the per-worker clock-alignment error report.
+rebasing, the membership timeline (worker joins/leaves and adaptive
+deadline adjustments), and the per-worker clock-alignment error report.
 
 Usage: python tools/trace_report.py TRACE_FILE [--top N] [--cluster]
 """
@@ -238,6 +239,42 @@ def cluster_report(events: list[dict], top: int = 10) -> str:
             f"  {worker_pids[pid]:<10} max {w['max_seg'] / 1e3:>9.3f} ms  "
             f"mean {mean / 1e3:>9.3f} ms  busy {w['busy'] / 1e3:>9.3f} ms"
         )
+
+    # --- membership timeline -------------------------------------------------
+    membership = sorted(
+        (
+            e for e in events
+            if e.get("ph") == "i" and e.get("name") in (
+                "cluster.worker_joined", "cluster.worker_left",
+                "cluster.deadline_adjusted",
+            )
+        ),
+        key=lambda e: e.get("ts", 0),
+    )
+    if membership:
+        lines.append("")
+        lines.append("membership timeline (joins, leaves, deadline "
+                     "adjustments):")
+        t0 = min(e["ts"] for e in spans) if spans else membership[0]["ts"]
+        for e in membership:
+            a = e.get("args", {})
+            if e["name"] == "cluster.worker_joined":
+                what = (
+                    f"worker {a.get('worker')} joined "
+                    f"(active={a.get('active')})"
+                )
+            elif e["name"] == "cluster.worker_left":
+                what = (
+                    f"worker {a.get('worker')} left "
+                    f"(active={a.get('active')})"
+                )
+            else:
+                prev = a.get("prev_s")
+                what = (
+                    f"deadline adjusted to {a.get('deadline_s')}s"
+                    + (f" (was {prev}s)" if prev is not None else "")
+                )
+            lines.append(f"  +{(e['ts'] - t0) / 1e3:>10.3f} ms  {what}")
 
     # --- clock alignment -----------------------------------------------------
     lines.append("")
