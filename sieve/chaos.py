@@ -22,11 +22,30 @@ Grammar — comma-separated items, each ``kind:worker@s<seg>[:param]``:
                           in flight, then reconnects with backoff — a
                           mid-segment network blip.
 
+Service-side kinds (ISSUE 7; consumed by sieve/service/server.py, where
+"segment" means the server's request sequence number and ``worker`` the
+handler thread drawing it — ``any`` is the deterministic choice):
+
+* ``svc_stall:any@sK:secs``   the handler sleeps ``secs`` (default 1.0)
+                              before answering request K — a stalled
+                              server thread; the deadline machinery must
+                              turn it into a typed ``deadline_exceeded``,
+                              never a silent hang.
+* ``svc_shed:any@sK``         request K is force-shed with a typed
+                              ``overloaded`` reply regardless of queue
+                              depth — admission-control injection.
+* ``backend_down:any@sK:secs`` the cold-compute backend reports down for
+                              ``secs`` (default 1.0) starting at request
+                              K — hot-index queries must keep answering
+                              while health degrades.
+
 ``worker`` is an integer id, or ``any``/``*`` for whichever worker draws
 the segment (the pull model makes a specific id probabilistic, ``any``
 deterministic). Directives are transported to the worker inside the
 ``assign`` message, so tests and tools/chaos_smoke.py compose multi-fault
-scenarios purely from config.
+scenarios purely from config. Each plane ignores the other plane's kinds
+(a cluster worker skips ``svc_*``; the service skips ``kill``/``stall``/
+...), so one ``--chaos`` string can drive a composed scenario end to end.
 
 Directives are consumed when taken (one-shot): a reassigned segment's
 replacement owner runs fault-free, which is what makes every composed
@@ -39,13 +58,27 @@ import dataclasses
 import threading
 
 ANY_WORKER = -1  # "any@sK": whichever worker draws segment K
-KINDS = ("kill", "stall", "drop_hb", "disconnect")
+KINDS = (
+    "kill",
+    "stall",
+    "drop_hb",
+    "disconnect",
+    "svc_stall",
+    "svc_shed",
+    "backend_down",
+)
+# kinds handled by the query service (sieve/service/); the cluster plane
+# ignores these and vice versa
+SERVICE_KINDS = ("svc_stall", "svc_shed", "backend_down")
 # default param (seconds) for kinds that take one; None = no param
 DEFAULT_PARAM: dict[str, float | None] = {
     "kill": None,
     "stall": 1.0,
     "drop_hb": None,
     "disconnect": 0.05,
+    "svc_stall": 1.0,
+    "svc_shed": None,
+    "backend_down": 1.0,
 }
 
 
@@ -109,8 +142,8 @@ def parse_chaos(spec: str) -> list[ChaosDirective]:
             )
         seg_id = int(seg[1:])
         if len(parts) == 3:
-            if kind == "kill":
-                raise ValueError(f"chaos item {item!r}: kill takes no param")
+            if DEFAULT_PARAM[kind] is None:
+                raise ValueError(f"chaos item {item!r}: {kind} takes no param")
             try:
                 param = float(parts[2])
             except ValueError:
@@ -150,3 +183,8 @@ class ChaosSchedule:
                     d for d in self._pending if id(d) not in taken
                 ]
         return [d.to_wire() for d in hit]
+
+    def extend(self, directives: list[ChaosDirective]) -> None:
+        """Inject more directives at runtime (service chaos endpoint)."""
+        with self._lock:
+            self._pending.extend(directives)

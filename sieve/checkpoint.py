@@ -31,6 +31,12 @@ Corruption handling on open:
 
 ``--resume`` replays the merge over ledger + remaining segments; a
 config-hash mismatch refuses to resume (the math would differ).
+
+Readers (the query service, ISSUE 7) use :meth:`Ledger.open_readonly`: a
+snapshot open that verifies the checksum but never quarantines, salvages,
+or flushes — a concurrent reader must not race the coordinator's
+atomic-replace or steal its corrupt-file recovery. A read-only ledger
+raises on :meth:`Ledger.record`.
 """
 
 from __future__ import annotations
@@ -100,6 +106,7 @@ class Ledger:
         # recovered) — callers emit the ledger_salvaged metrics event
         self.salvaged = 0
         self.quarantined: str | None = None
+        self.read_only = False
 
     @classmethod
     def open(cls, config: "SieveConfig") -> "Ledger":
@@ -141,6 +148,40 @@ class Ledger:
             ledger.salvaged = salvaged
             ledger.quarantined = str(quarantined)
             ledger._flush()  # rewrite a clean, checksummed ledger now
+        return ledger
+
+    @classmethod
+    def open_readonly(cls, config: "SieveConfig") -> "Ledger":
+        """Snapshot open for readers: verify, never mutate.
+
+        Unlike :meth:`open`, a corrupt file is NOT quarantined or salvaged
+        (that is the writing coordinator's recovery to perform — a reader
+        racing it could steal the atomic-replace) and nothing is ever
+        flushed back. A missing ledger is an empty snapshot, not an error:
+        the service starts cold and fills from backends.
+        """
+        assert config.checkpoint_dir is not None
+        path = Path(config.checkpoint_dir) / LEDGER_NAME
+        chash = config.config_hash()
+        entries: dict[int, dict] = {}
+        if path.exists():
+            data, corrupt = cls._parse(path.read_text())
+            if data is None:
+                raise LedgerCorrupt(
+                    f"ledger at {path} is corrupt ({corrupt}); refusing "
+                    "read-only open. Run the owning coordinator (which "
+                    "quarantines and salvages) or restore a known-good "
+                    "ledger."
+                )
+            if data.get("config_hash") != chash:
+                raise LedgerMismatch(
+                    f"ledger at {path} was written for config_hash="
+                    f"{data.get('config_hash')}, reader expects {chash}; "
+                    "the segment counts would describe a different sieve"
+                )
+            entries = {int(k): v for k, v in data.get("completed", {}).items()}
+        ledger = cls(path, chash, entries)
+        ledger.read_only = True
         return ledger
 
     @staticmethod
@@ -199,10 +240,16 @@ class Ledger:
     def record(self, res: SegmentResult) -> None:
         """Idempotent: the ledger keys on segment id, so a segment processed
         twice (e.g. after worker-failure reassignment) is counted once."""
+        if self.read_only:
+            raise LedgerMismatch(
+                f"ledger at {self.path} was opened read-only; record() is "
+                "reserved for the owning coordinator"
+            )
         self._entries[res.seg_id] = res.to_dict()
         self._flush()
 
     def _flush(self) -> None:
+        assert not self.read_only, "read-only ledger must never flush"
         completed = {str(k): v for k, v in self._entries.items()}
         payload = {
             "version": LEDGER_VERSION,

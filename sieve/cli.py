@@ -2,6 +2,12 @@
 
 Example:
     python -m sieve --n 1e9 --backend jax --segments 256 --packing odds --twins
+
+The ``serve`` subcommand starts the persistent query plane over a sieved
+checkpoint dir (sieve/service/):
+
+    python -m sieve serve --n 1e9 --segments 256 --checkpoint-dir ck \\
+        --addr 127.0.0.1:7723
 """
 
 from __future__ import annotations
@@ -128,6 +134,14 @@ def config_from_args(args: argparse.Namespace) -> SieveConfig:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        try:
+            return _serve(argv[1:])
+        except (ValueError, RuntimeError, ImportError) as e:
+            print(f"sieve: error: {e}", file=sys.stderr)
+            return 2
     args = build_parser().parse_args(argv)
     try:
         if args.emit_primes is not None:
@@ -140,6 +154,111 @@ def main(argv: list[str] | None = None) -> int:
     except (ValueError, RuntimeError, ImportError) as e:
         print(f"sieve: error: {e}", file=sys.stderr)
         return 2
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sieve serve",
+        description="Persistent query server: pi / count / nth_prime / "
+                    "primes over the RPC plane (sieve/service/)",
+    )
+    p.add_argument("--addr", default="127.0.0.1:7723",
+                   help="listen address host:port (port 0 picks a free one; "
+                        "the chosen address is printed as a JSON line)")
+    p.add_argument("--n", type=_parse_n, required=True,
+                   help="the sieved range [2, N] the checkpoint dir covers "
+                        "(must match the sieving run for its config hash)")
+    p.add_argument("--packing", choices=PACKINGS, default="odds")
+    p.add_argument("--segments", type=int, default=None, dest="n_segments")
+    p.add_argument("--segment-size", type=int, default=None,
+                   dest="segment_values")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="sieved checkpoint dir to index (omit for a "
+                        "cold-only server)")
+    p.add_argument("--backend", choices=[b for b in BACKENDS
+                                         if b != "cpu-cluster"],
+                   default="cpu-numpy",
+                   help="cold-tier compute backend for uncovered ranges")
+    p.add_argument("--queue-limit", type=int, default=None,
+                   help="admission queue bound (default SIEVE_SVC_QUEUE/64; "
+                        "beyond it requests get a typed overloaded reply)")
+    p.add_argument("--service-workers", type=int, default=None,
+                   help="handler threads (default SIEVE_SVC_WORKERS/4)")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="default per-request deadline "
+                        "(default SIEVE_SVC_DEADLINE_S/30)")
+    p.add_argument("--chaos", default=None,
+                   help="service fault schedule, e.g. 'svc_stall:any@s3:2.0,"
+                        "svc_shed:any@s5,backend_down:any@s7:1.0' (segment "
+                        "number = request sequence number)")
+    p.add_argument("--trace", default=None, dest="trace_file", metavar="FILE",
+                   help="write rpc.query / queue-wait / materialize / cold "
+                        "spans as Chrome trace-event JSON on shutdown")
+    p.add_argument("--metrics-file", default=None, dest="metrics_file")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress per-request stderr event lines")
+    return p
+
+
+def _serve(argv: list[str]) -> int:
+    args = build_serve_parser().parse_args(argv)
+    config = SieveConfig(
+        n=args.n,
+        backend=args.backend,
+        packing=args.packing,
+        n_segments=args.n_segments,
+        segment_values=args.segment_values,
+        checkpoint_dir=args.checkpoint_dir,
+        trace_file=args.trace_file,
+        metrics_file=args.metrics_file,
+        quiet=args.quiet,
+        chaos=args.chaos,
+    )
+
+    from sieve import metrics, trace
+    from sieve.service import ServiceSettings, SieveService
+
+    overrides = {}
+    if args.queue_limit is not None:
+        overrides["queue_limit"] = args.queue_limit
+    if args.service_workers is not None:
+        overrides["workers"] = args.service_workers
+    if args.deadline_s is not None:
+        overrides["default_deadline_s"] = args.deadline_s
+    settings = ServiceSettings.from_env(**overrides)
+
+    file_sink = None
+    if config.metrics_file:
+        file_sink = metrics.FileSink(config.metrics_file)
+        metrics.add_sink(file_sink)
+    if config.trace_file:
+        trace.enable()
+    service = SieveService(config, settings, addr=args.addr)
+    try:
+        service.start()
+        # one parseable line so wrappers (tools/service_smoke.py) can find
+        # the bound port when --addr uses port 0
+        print(json.dumps({
+            "event": "serving",
+            "addr": service.addr,
+            "covered_hi": service.index.covered_hi,
+            "total_primes": service.index.total_primes,
+            "segments": len(service.index.segments),
+        }), flush=True)
+        import threading
+
+        threading.Event().wait()  # serve until interrupted
+    except KeyboardInterrupt:
+        pass
+    finally:
+        service.stop()
+        if config.trace_file:
+            trace.disable()
+            trace.save(config.trace_file)
+        if file_sink is not None:
+            metrics.remove_sink(file_sink)
+            file_sink.close()
+    return 0
 
 
 def _emit_primes(args: argparse.Namespace) -> int:

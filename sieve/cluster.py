@@ -9,7 +9,8 @@ by progress heartbeats; a dead or silent worker's segment returns to the
 queue for a different owner. Results are idempotent (keyed on seg_id), so
 double-processing after reassignment cannot double-count.
 
-Wire protocol: 8-byte big-endian length prefix + JSON. Messages:
+Wire protocol: the shared length-prefixed JSON framing (sieve/rpc.py,
+also used by the query service). Messages:
   worker -> coordinator: {"type": "hello", "worker_id": i}
                          {"type": "progress", "seg_id", "t_recv", "t_hb"}
                          {"type": "done", "result": SegmentResult dict,
@@ -61,13 +62,11 @@ consumed at assign time, so reassigned segments run fault-free.
 from __future__ import annotations
 
 import collections
-import json
 import math
 import os
 import queue
 import random
 import socket
-import struct
 import subprocess
 import sys
 import threading
@@ -81,6 +80,8 @@ from sieve.checkpoint import Ledger
 from sieve.config import SieveConfig
 from sieve.coordinator import SieveResult, merge_results
 from sieve.metrics import MetricsLogger, registry
+from sieve.rpc import parse_addr as _parse_addr
+from sieve.rpc import recv_msg, send_msg
 from sieve.seed import seed_primes
 from sieve.segments import plan_segments, validate_plan
 from sieve.worker import SegmentResult
@@ -102,40 +103,6 @@ def _worker_recv_timeout_s() -> float:
     coordinator went silent reconnects (or gives up) instead of blocking
     in recv forever."""
     return float(os.environ.get("SIEVE_WORKER_RECV_TIMEOUT_S", "30"))
-
-
-# --- framing -----------------------------------------------------------------
-
-
-def send_msg(sock: socket.socket, msg: dict) -> None:
-    blob = json.dumps(msg).encode()
-    sock.sendall(struct.pack(">Q", len(blob)) + blob)
-
-
-def recv_msg(sock: socket.socket) -> dict | None:
-    header = _recv_exact(sock, 8)
-    if header is None:
-        return None
-    (length,) = struct.unpack(">Q", header)
-    blob = _recv_exact(sock, length)
-    if blob is None:
-        return None
-    return json.loads(blob)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
-
-
-def _parse_addr(addr: str) -> tuple[str, int]:
-    host, port = addr.rsplit(":", 1)
-    return host, int(port)
 
 
 # --- worker role -------------------------------------------------------------

@@ -60,6 +60,16 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "worker_left": {"worker", "reason", "run_id", "active"},
     "deadline_adjusted": {"deadline_s", "prev_s", "p95_s", "run_id"},
     "ledger_salvaged": {"salvaged", "quarantined"},
+    # query service (ISSUE 7): one service_request per admitted request
+    # ("outcome" is ok/deadline_exceeded/degraded/bad_request/internal,
+    # "source" index/cold/mixed/none); shed requests get service_shed
+    # instead (never both). service_coalesced marks a follower joining a
+    # leader's in-flight cold range; service_degraded marks health
+    # transitions (entering=True/False).
+    "service_request": {"op", "outcome", "source", "ms"},
+    "service_shed": {"op", "queue_depth"},
+    "service_coalesced": {"op", "lo", "hi"},
+    "service_degraded": {"entering", "reason"},
 }
 
 
@@ -291,8 +301,11 @@ class MetricsLogger:
         self.stream.write(json.dumps(record) + "\n")
         self.stream.flush()
 
-    def event(self, kind: str, **fields: Any) -> None:
-        self._emit({"event": kind, **fields})
+    def event(self, kind: str, quietable: bool = False, **fields: Any) -> None:
+        """Emit one structured record. ``quietable=True`` marks it as
+        per-request/per-segment chatter that ``--quiet`` drops from the
+        console (sinks always get it)."""
+        self._emit({"event": kind, **fields}, per_segment=quietable)
 
     def segment(self, res: "SegmentResult") -> None:
         reg = registry()

@@ -1,0 +1,53 @@
+"""Length-prefixed RPC framing shared by the cluster and service planes.
+
+One wire format for every TCP endpoint in the repo (SURVEY.md section
+3.2): an 8-byte big-endian length prefix followed by a JSON object. The
+cpu-cluster transport (sieve/cluster.py) ships seed primes, segment
+assignments, and telemetry over it; the query service
+(sieve/service/server.py) answers ``pi``/``count``/``nth_prime``/
+``primes`` requests over the very same framing, so a worker host and a
+query client speak to the coordinator with the same four functions.
+
+``recv_msg`` returns ``None`` on a cleanly closed peer (EOF mid-header
+or mid-body), letting callers distinguish an orderly close from a
+protocol error; socket timeouts propagate as ``socket.timeout`` so both
+planes can bound every read (a dead peer must never park a thread in
+``recv`` forever — ISSUE 6/7).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+
+def send_msg(sock: socket.socket, msg: dict) -> None:
+    blob = json.dumps(msg).encode()
+    sock.sendall(struct.pack(">Q", len(blob)) + blob)
+
+
+def recv_msg(sock: socket.socket) -> dict | None:
+    header = _recv_exact(sock, 8)
+    if header is None:
+        return None
+    (length,) = struct.unpack(">Q", header)
+    blob = _recv_exact(sock, length)
+    if blob is None:
+        return None
+    return json.loads(blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def parse_addr(addr: str) -> tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)

@@ -4,20 +4,56 @@ SURVEY.md section 0: the reference computes seed primes on the host and
 ships them to every worker. For the north-star N=1e12 the seed set is
 pi(1e6) = 78,498 primes (~628 KB as int64) — trivially replicated, so a
 simple numpy sieve is the right tool; no need for segmentation here.
+
+``seed_primes`` memoizes its last few results (ISSUE 7): the query
+service and ``primes_in_range`` call it per request/slice with a handful
+of distinct limits, and recomputing a 1e7 sieve per query would dominate
+hot-path latency. Cached arrays are returned read-only so one caller
+cannot corrupt another's view; callers that need to mutate must copy.
 """
 
 from __future__ import annotations
 
+import collections
 import math
+import threading
 
 import numpy as np
 
+_CACHE_SIZE = 8  # distinct limits kept (largest seed set ~628 KB at 1e7)
+_cache: "collections.OrderedDict[int, np.ndarray]" = collections.OrderedDict()
+_cache_lock = threading.Lock()
+
 
 def seed_primes(limit: int) -> np.ndarray:
-    """All primes p <= limit, ascending, as int64.
+    """All primes p <= limit, ascending, as int64 (read-only array).
 
     Plain (non-segmented) Sieve of Eratosthenes; O(limit) memory as bool.
+    Memoized on ``limit`` (small LRU); results are bit-exact vs uncached.
     """
+    limit = int(limit)
+    with _cache_lock:
+        hit = _cache.get(limit)
+        if hit is not None:
+            _cache.move_to_end(limit)
+            return hit
+    primes = _seed_primes_uncached(limit)
+    primes.setflags(write=False)
+    with _cache_lock:
+        _cache[limit] = primes
+        _cache.move_to_end(limit)
+        while len(_cache) > _CACHE_SIZE:
+            _cache.popitem(last=False)
+    return primes
+
+
+def seed_cache_clear() -> None:
+    """Drop all memoized seed sets (tests, memory-pressure hooks)."""
+    with _cache_lock:
+        _cache.clear()
+
+
+def _seed_primes_uncached(limit: int) -> np.ndarray:
     if limit < 2:
         return np.zeros(0, dtype=np.int64)
     flags = np.ones(limit + 1, dtype=bool)

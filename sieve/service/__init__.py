@@ -1,0 +1,41 @@
+"""Sieve-as-a-service: the robust query plane (ISSUE 7 tentpole).
+
+The compute plane (coordinator/mesh/cluster) fills a checkpoint ledger;
+this package promotes that ledger into a queryable store and serves
+``pi`` / ``count`` / ``nth_prime`` / ``primes`` over the shared RPC
+framing (sieve/rpc.py), failure-first:
+
+* :mod:`sieve.service.index` — read-only segment-boundary index with
+  O(log segments) prefix counts and an LRU of materialized bitsets.
+* :mod:`sieve.service.server` — :class:`SieveService`: bounded admission
+  queue with typed load-shedding, per-request deadlines with partial
+  answers, single-flight coalescing of cold ranges, and a circuit
+  breaker that keeps hot-index queries alive while the cold backend is
+  down (degraded health, never a wrong number).
+* :mod:`sieve.service.client` — :class:`ServiceClient`, the blocking
+  client used by the CLI, tests, and tools/service_smoke.py.
+"""
+
+from sieve.service.client import ServiceClient, ServiceError
+from sieve.service.index import QueryCtx, SieveIndex
+from sieve.service.server import (
+    BadRequest,
+    DeadlineExceeded,
+    Degraded,
+    Overloaded,
+    ServiceSettings,
+    SieveService,
+)
+
+__all__ = [
+    "BadRequest",
+    "DeadlineExceeded",
+    "Degraded",
+    "Overloaded",
+    "QueryCtx",
+    "ServiceClient",
+    "ServiceError",
+    "ServiceSettings",
+    "SieveIndex",
+    "SieveService",
+]

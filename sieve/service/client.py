@@ -1,0 +1,102 @@
+"""Blocking client for the query service (one socket, one request at a
+time). Concurrency = one client per thread; the framing and the server's
+per-connection send lock keep each connection's request/reply stream
+ordered, so a synchronous client never sees an interleaved reply.
+
+Typed errors surface as :class:`ServiceError` with the server's error
+kind (``overloaded`` / ``deadline_exceeded`` / ``degraded`` /
+``bad_request`` / ``internal``) and any partial answer; callers that
+want the raw reply dict (tools/service_smoke.py inspects typed outcomes)
+use :meth:`ServiceClient.query`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+from typing import Any
+
+from sieve.rpc import parse_addr, recv_msg, send_msg
+
+
+class ServiceError(RuntimeError):
+    def __init__(self, kind: str, detail: str, partial: dict | None = None):
+        super().__init__(f"{kind}: {detail}")
+        self.kind = kind
+        self.detail = detail
+        self.partial = partial
+
+
+class ServiceClient:
+    def __init__(self, addr: str, timeout_s: float = 60.0):
+        host, port = parse_addr(addr)
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._ids = itertools.count(1)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ServiceClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- raw -------------------------------------------------------------
+
+    def _call(self, msg: dict) -> dict:
+        msg.setdefault("id", next(self._ids))
+        send_msg(self._sock, msg)
+        reply = recv_msg(self._sock)
+        if reply is None:
+            raise ConnectionError("service closed the connection")
+        return reply
+
+    def query(self, op: str, deadline_s: float | None = None,
+              **params: Any) -> dict:
+        """One query; returns the raw reply dict (ok or typed error)."""
+        msg: dict[str, Any] = {"type": "query", "op": op, **params}
+        if deadline_s is not None:
+            msg["deadline_s"] = deadline_s
+        return self._call(msg)
+
+    def _value(self, reply: dict):
+        if reply.get("ok"):
+            return reply["value"]
+        raise ServiceError(
+            reply.get("error", "internal"),
+            reply.get("detail", ""),
+            reply.get("partial"),
+        )
+
+    # --- ops -------------------------------------------------------------
+
+    def pi(self, x: int, deadline_s: float | None = None) -> int:
+        return self._value(self.query("pi", deadline_s, x=x))
+
+    def count(self, lo: int, hi: int, kind: str = "primes",
+              deadline_s: float | None = None) -> int:
+        return self._value(
+            self.query("count", deadline_s, lo=lo, hi=hi, kind=kind)
+        )
+
+    def nth_prime(self, k: int, deadline_s: float | None = None) -> int:
+        return self._value(self.query("nth_prime", deadline_s, k=k))
+
+    def primes(self, lo: int, hi: int,
+               deadline_s: float | None = None) -> list[int]:
+        return self._value(self.query("primes", deadline_s, lo=lo, hi=hi))
+
+    # --- control plane ---------------------------------------------------
+
+    def health(self) -> dict:
+        return self._call({"type": "health"})
+
+    def stats(self) -> dict:
+        return self._call({"type": "stats"})["stats"]
+
+    def inject_chaos(self, spec: str) -> dict:
+        return self._call({"type": "chaos", "spec": spec})

@@ -1,0 +1,279 @@
+"""Hot index tier: the checkpoint ledger as a queryable store.
+
+A sieved checkpoint dir holds one :class:`SegmentResult` per completed
+segment — per-segment prime counts keyed on segment boundaries. Sorted,
+that is a prefix-sum index: ``pi(boundary)`` is O(log segments) with no
+bitset touched. Values strictly inside a segment need flags for the
+partial chunk only; those are materialized by the local numpy marking in
+bounded chunks and kept in an LRU so a repeated hot query re-sieves
+nothing (lru_hits vs materialized counters make that provable).
+
+Only the *contiguous* prefix of segments starting at 2 is indexed: a
+partially-sieved ledger may have holes (cluster runs complete segments
+out of order), and a prefix count across a hole would be wrong. Ranges
+past :attr:`SieveIndex.covered_hi` are the server's cold tier.
+
+Per-query bookkeeping travels in a :class:`QueryCtx`: which tiers were
+touched (drives the ``source`` field and the index-hit counter), the
+prefix answered so far (drives typed ``deadline_exceeded`` partials),
+and the deadline hook called before every chunk of real work.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import dataclasses
+import math
+import threading
+from typing import Callable, Sequence
+
+import numpy as np
+
+from sieve import trace
+from sieve.backends.cpu_numpy import sieve_segment_flags
+from sieve.bitset import get_layout
+from sieve.seed import seed_primes
+from sieve.worker import SegmentResult
+
+# Materialization chunk: matches enumerate._SLICE so one chunk is always
+# a modest allocation no matter how large the ledger's segments are.
+INDEX_CHUNK = 1 << 24
+
+
+@dataclasses.dataclass
+class QueryCtx:
+    """Per-request bookkeeping threaded through index and cold tiers."""
+
+    # deadline hook: called before each chunk of real work; raises
+    # DeadlineExceeded (server-defined) reading answered_hi/count_so_far
+    check: Callable[[], None] | None = None
+    # tier provenance for the reply's "source" and the hit counters
+    index: bool = False
+    lru_hit: bool = False
+    materialized: bool = False
+    cold: bool = False
+    cold_cached: bool = False
+    # progress for typed partial answers (prefix [2, answered_hi) done)
+    answered_hi: int = 2
+    count_so_far: int = 0
+
+    def tick(self) -> None:
+        if self.check is not None:
+            self.check()
+
+    def source(self) -> str:
+        hot = self.index or self.lru_hit or self.materialized or self.cold_cached
+        if self.cold:
+            return "mixed" if hot else "cold"
+        return "index" if hot else "none"
+
+
+class BitsetLRU:
+    """Bounded cache of materialized flag arrays keyed on (lo, hi)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._cache: "collections.OrderedDict[tuple[int, int], np.ndarray]" = (
+            collections.OrderedDict()
+        )
+
+    def get(self, lo: int, hi: int) -> np.ndarray | None:
+        with self._lock:
+            flags = self._cache.get((lo, hi))
+            if flags is not None:
+                self._cache.move_to_end((lo, hi))
+            return flags
+
+    def put(self, lo: int, hi: int, flags: np.ndarray) -> None:
+        flags.setflags(write=False)
+        with self._lock:
+            self._cache[(lo, hi)] = flags
+            self._cache.move_to_end((lo, hi))
+            while len(self._cache) > self.capacity:
+                self._cache.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+
+class SieveIndex:
+    """Sorted segment-boundary index over a read-only ledger snapshot."""
+
+    def __init__(
+        self,
+        packing: str,
+        entries: dict[int, SegmentResult] | Sequence[SegmentResult],
+        lru_segments: int = 32,
+    ):
+        self.packing = packing
+        self.layout = get_layout(packing)
+        segs = sorted(
+            entries.values() if isinstance(entries, dict) else entries,
+            key=lambda r: r.lo,
+        )
+        # contiguous prefix from 2 only — counts across a hole are wrong
+        self.segments: list[SegmentResult] = []
+        want_lo = 2
+        for r in segs:
+            if r.lo != want_lo:
+                break
+            self.segments.append(r)
+            want_lo = r.hi
+        self.dropped_segments = len(segs) - len(self.segments)
+        self._his = [r.hi for r in self.segments]
+        self._prefix = np.cumsum(
+            [r.count for r in self.segments], dtype=np.int64
+        )
+        self.covered_hi = self._his[-1] if self.segments else 2
+        self.total_primes = int(self._prefix[-1]) if self.segments else 0
+        self.bounds: list[int] = [r.lo for r in self.segments] + (
+            [self.covered_hi] if self.segments else []
+        )
+        self.lru = BitsetLRU(lru_segments)
+        self._stat_lock = threading.Lock()
+        self.lru_hits = 0
+        self.materialized = 0
+
+    # --- flags -----------------------------------------------------------
+
+    def get_flags(self, lo: int, hi: int, ctx: QueryCtx) -> np.ndarray:
+        """Candidate flags for [lo, hi): LRU, else local sieve + cache.
+
+        [lo, hi) must fit one materialization chunk; callers chunk via
+        :meth:`chunks`. The deadline hook fires before a fresh sieve
+        (cache hits are always allowed through — they are the point)."""
+        flags = self.lru.get(lo, hi)
+        if flags is not None:
+            ctx.lru_hit = True
+            with self._stat_lock:
+                self.lru_hits += 1
+            return flags
+        ctx.tick()
+        with trace.span("query.materialize", lo=lo, hi=hi):
+            seeds = seed_primes(math.isqrt(hi - 1))
+            flags = sieve_segment_flags(self.packing, lo, hi, seeds)
+        ctx.materialized = True
+        with self._stat_lock:
+            self.materialized += 1
+        self.lru.put(lo, hi, flags)
+        return flags
+
+    @staticmethod
+    def chunks(lo: int, hi: int, chunk: int = INDEX_CHUNK):
+        for clo in range(lo, hi, chunk):
+            yield clo, min(clo + chunk, hi)
+
+    def flags_for_slice(self, slo: int, shi: int, ctx: QueryCtx) -> np.ndarray | None:
+        """enumerate.primes_in_range ``flags_fn``: serve a slice from the
+        hot tier, or None when it lies past the covered range (the
+        caller's cold tier takes over). Slices never straddle a segment
+        boundary (the enumerate ``bounds`` contract), so a cached
+        enclosing range can be bit-sliced exactly."""
+        if shi > self.covered_hi or not self.segments:
+            return None
+        flags = self.lru.get(slo, shi)
+        if flags is not None:
+            ctx.lru_hit = True
+            with self._stat_lock:
+                self.lru_hits += 1
+            return flags
+        j = bisect.bisect_right(self.bounds, slo) - 1
+        seg = self.segments[min(j, len(self.segments) - 1)]
+        whole = self.lru.get(seg.lo, seg.hi)
+        if whole is not None:
+            ctx.lru_hit = True
+            with self._stat_lock:
+                self.lru_hits += 1
+            off = self.layout.nbits(seg.lo, slo)
+            return whole[off : off + self.layout.nbits(slo, shi)]
+        if shi - slo > INDEX_CHUNK:
+            return None  # oversized ask; let the caller sub-chunk
+        return self.get_flags(slo, shi, ctx)
+
+    # --- prefix counts ---------------------------------------------------
+
+    def count_upto(self, v: int, ctx: QueryCtx) -> int:
+        """Primes in [2, v), for 2 <= v <= covered_hi.
+
+        Boundary hits are pure O(log segments); interior values add a
+        partial in-segment count over materialized chunks."""
+        if v <= 2:
+            ctx.answered_hi = max(ctx.answered_hi, 2)
+            return 0
+        if v > self.covered_hi:
+            raise ValueError(
+                f"count_upto({v}) beyond covered_hi={self.covered_hi}"
+            )
+        ctx.index = True
+        j = bisect.bisect_right(self._his, v)
+        base = int(self._prefix[j - 1]) if j else 0
+        if j == len(self.segments) or v == self.segments[j].lo:
+            ctx.answered_hi = max(ctx.answered_hi, v)
+            ctx.count_so_far = max(ctx.count_so_far, base)
+            return base
+        seg = self.segments[j]
+        ctx.count_so_far = max(ctx.count_so_far, base)
+        # partial in-segment count: chunks are aligned from seg.lo so a
+        # repeated hot query hits the same LRU keys. The final chunk is
+        # materialized whole (up to the segment end, capped at one chunk)
+        # and bit-sliced to v, again for key stability.
+        total = base + self.layout.extras_in(seg.lo, v)
+        for clo, chi in self.chunks(seg.lo, seg.hi):
+            if clo >= v:
+                break
+            flags = self.get_flags(clo, chi, ctx)
+            if chi > v:
+                nb = self.layout.nbits(clo, v)
+                total += int(np.count_nonzero(flags[:nb]))
+            else:
+                total += int(np.count_nonzero(flags))
+            ctx.answered_hi = max(ctx.answered_hi, min(chi, v))
+            ctx.count_so_far = max(ctx.count_so_far, total)
+        return total
+
+    # --- selection -------------------------------------------------------
+
+    def nth(self, k: int, ctx: QueryCtx) -> int:
+        """Value of the k-th prime (1-indexed), for 1 <= k <= total_primes."""
+        if not 1 <= k <= self.total_primes:
+            raise ValueError(f"nth({k}) outside indexed range")
+        ctx.index = True
+        j = int(np.searchsorted(self._prefix, k, side="left"))
+        base = int(self._prefix[j - 1]) if j else 0
+        seg = self.segments[j]
+        r = k - base  # r-th prime within segment j
+        # layout extras (2/3/5) always precede every candidate (>= 7 for
+        # wheel30, >= 3 for odds) in any segment that contains them
+        extras = [p for p in self.layout.extra_primes if seg.lo <= p < seg.hi]
+        if r <= len(extras):
+            return extras[r - 1]
+        r -= len(extras)
+        ctx.count_so_far = max(ctx.count_so_far, base + len(extras))
+        for clo, chi in self.chunks(seg.lo, seg.hi):
+            flags = self.get_flags(clo, chi, ctx)
+            c = int(np.count_nonzero(flags))
+            if r <= c:
+                pos = np.nonzero(flags)[0][r - 1]
+                return int(self.layout.values_np(clo, np.array([pos]))[0])
+            r -= c
+            ctx.count_so_far += c
+            ctx.answered_hi = max(ctx.answered_hi, chi)
+        raise AssertionError(
+            f"segment {seg.seg_id} count={seg.count} disagrees with its "
+            f"materialized flags — ledger/compute mismatch"
+        )
+
+    def stats(self) -> dict:
+        with self._stat_lock:
+            return {
+                "segments": len(self.segments),
+                "dropped_segments": self.dropped_segments,
+                "covered_hi": self.covered_hi,
+                "total_primes": self.total_primes,
+                "lru_hits": self.lru_hits,
+                "materialized": self.materialized,
+                "lru_entries": len(self.lru),
+            }

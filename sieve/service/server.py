@@ -1,0 +1,805 @@
+"""The query server: bounded admission, deadlines, coalescing, degradation.
+
+One :class:`SieveService` owns four tiers:
+
+* **index** — :class:`~sieve.service.index.SieveIndex` over a
+  ``Ledger.open_readonly`` snapshot; O(log segments) prefix counts plus
+  an LRU of materialized bitsets. Hot queries never touch a backend.
+* **admission** — a bounded queue in front of a small worker pool. A
+  full queue (or an injected ``svc_shed``) returns a typed
+  ``overloaded`` reply immediately — a request is never silently
+  parked. Every admitted request carries a deadline; blowing it returns
+  a typed ``deadline_exceeded`` with the partial prefix answered so far.
+* **cold** — ranges past the index fall through to a real backend via
+  the :class:`~sieve.worker.SieveWorker` seam, chunked on a fixed grid
+  so concurrent overlapping queries coalesce: one leader computes a
+  chunk, followers wait on its flight and share the result, and the
+  result is cached so a repeated cold query becomes hot.
+* **degradation** — a circuit breaker around the backend: a failure
+  streak (or an injected ``backend_down``) opens it for a cooldown,
+  cold queries fail fast with a typed ``degraded`` reply, and the
+  server keeps answering hot-index queries while reporting degraded
+  health. It never trades exactness for availability — a reply is
+  exact or it is a typed error.
+
+Wire protocol (sieve/rpc.py framing; one JSON object per message):
+
+    {"type": "query", "id": i, "op": "pi", "x": 10**9, "deadline_s": 2}
+    {"type": "reply", "id": i, "ok": true, "op": "pi", "value": 50847534,
+     "source": "index", "elapsed_ms": 0.4}
+    {"type": "reply", "id": i, "ok": false, "error": "deadline_exceeded",
+     "detail": "...", "partial": {"answered_hi": ..., "pi_so_far": ...}}
+
+``health`` / ``stats`` / ``chaos`` messages are answered inline by the
+connection reader — health stays observable even when the queue is full.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import queue
+import socket
+import threading
+import time
+from typing import TYPE_CHECKING, Any
+
+import numpy as np
+
+from sieve import trace
+from sieve.backends import make_worker
+from sieve.chaos import SERVICE_KINDS, ChaosSchedule, parse_chaos
+from sieve.checkpoint import Ledger
+from sieve.enumerate import MAX_HI, primes_in_range
+from sieve.metrics import MetricsLogger, registry
+from sieve.rpc import parse_addr, recv_msg, send_msg
+from sieve.seed import seed_primes
+from sieve.service.index import QueryCtx, SieveIndex
+
+if TYPE_CHECKING:
+    from sieve.config import SieveConfig
+
+
+# --- typed faults ------------------------------------------------------------
+# Every non-exact outcome is one of these; the handler maps them 1:1 onto
+# typed error replies. Anything else escaping a handler is "internal".
+
+
+class Overloaded(Exception):
+    """Admission refused: queue full or svc_shed injected."""
+
+
+class DeadlineExceeded(Exception):
+    def __init__(self, answered_hi: int, count_so_far: int):
+        super().__init__(f"deadline exceeded at {answered_hi}")
+        self.answered_hi = answered_hi
+        self.count_so_far = count_so_far
+
+
+class Degraded(Exception):
+    """Cold tier unavailable (breaker open / backend_down injected)."""
+
+
+class BadRequest(Exception):
+    pass
+
+
+_ERROR_KIND = {
+    Overloaded: "overloaded",
+    DeadlineExceeded: "deadline_exceeded",
+    Degraded: "degraded",
+    BadRequest: "bad_request",
+}
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, default))
+
+
+@dataclasses.dataclass
+class ServiceSettings:
+    """Service knobs; every default has a ``SIEVE_SVC_*`` env override."""
+
+    queue_limit: int = 64
+    workers: int = 4
+    default_deadline_s: float = 30.0
+    lru_segments: int = 32
+    cold_chunk: int = 1 << 22
+    cold_cache_entries: int = 4096
+    max_primes: int = 200_000
+    max_pair_span: int = 10**8
+    breaker_fails: int = 3
+    breaker_cooldown_s: float = 5.0
+    # test/chaos knob: extra latency per cold compute, to simulate a
+    # saturated backend deterministically (coalescing/shed scenarios)
+    cold_delay_s: float = 0.0
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "ServiceSettings":
+        s = cls(
+            queue_limit=_env_int("SIEVE_SVC_QUEUE", cls.queue_limit),
+            workers=_env_int("SIEVE_SVC_WORKERS", cls.workers),
+            default_deadline_s=_env_float(
+                "SIEVE_SVC_DEADLINE_S", cls.default_deadline_s
+            ),
+            lru_segments=_env_int("SIEVE_SVC_LRU", cls.lru_segments),
+            cold_chunk=_env_int("SIEVE_SVC_COLD_CHUNK", cls.cold_chunk),
+            cold_cache_entries=_env_int(
+                "SIEVE_SVC_COLD_CACHE", cls.cold_cache_entries
+            ),
+            max_primes=_env_int("SIEVE_SVC_MAX_PRIMES", cls.max_primes),
+            max_pair_span=_env_int(
+                "SIEVE_SVC_MAX_PAIR_SPAN", cls.max_pair_span
+            ),
+            breaker_fails=_env_int("SIEVE_SVC_BREAKER_FAILS", cls.breaker_fails),
+            breaker_cooldown_s=_env_float(
+                "SIEVE_SVC_BREAKER_COOLDOWN_S", cls.breaker_cooldown_s
+            ),
+            cold_delay_s=_env_float("SIEVE_SVC_COLD_DELAY_S", cls.cold_delay_s),
+        )
+        return dataclasses.replace(s, **overrides)
+
+
+class ColdBackend:
+    """Circuit-broken wrapper around the configured SieveWorker backend.
+
+    Computes exact prime counts for cold chunks. Consecutive failures
+    (``breaker_fails``) open the breaker for ``breaker_cooldown_s``;
+    while open — or while an injected ``backend_down`` window is live —
+    every call fails fast with :class:`Degraded` so the worker pool is
+    never parked on a dead backend. One lock serializes the backend: it
+    models a single saturated compute resource and keeps non-thread-safe
+    backends (jax) correct.
+    """
+
+    def __init__(self, config: "SieveConfig", settings: ServiceSettings,
+                 on_transition=None):
+        self.config = config
+        self.settings = settings
+        self._worker = None  # lazy: a cold-only server may never need it
+        self._lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._fail_streak = 0
+        self._down_until = 0.0
+        self._down_reason = ""
+        self._degraded = False
+        self._on_transition = on_transition or (lambda entering, reason: None)
+
+    def force_down(self, secs: float, reason: str) -> None:
+        """Chaos/backend_down: report down for ``secs`` from now."""
+        with self._state_lock:
+            self._down_until = max(self._down_until, trace.now_s() + secs)
+            self._down_reason = reason
+        self._update_health()
+
+    def is_down(self) -> tuple[bool, str]:
+        with self._state_lock:
+            if trace.now_s() < self._down_until:
+                return True, self._down_reason
+        return False, ""
+
+    @property
+    def degraded(self) -> bool:
+        self._update_health()
+        return self._degraded
+
+    def _update_health(self) -> None:
+        with self._state_lock:
+            now_down = trace.now_s() < self._down_until
+            if now_down != self._degraded:
+                self._degraded = now_down
+                reason = self._down_reason if now_down else "recovered"
+                transition = (now_down, reason)
+            else:
+                transition = None
+        if transition is not None:
+            self._on_transition(*transition)
+
+    def count_range(self, lo: int, hi: int) -> int:
+        """Exact primes in [lo, hi) via the backend, or raise Degraded."""
+        down, reason = self.is_down()
+        if down:
+            raise Degraded(f"cold backend down: {reason}")
+        if self.settings.cold_delay_s > 0:
+            # simulated saturation (deterministic chaos/smoke scenarios)
+            time.sleep(self.settings.cold_delay_s)
+        seeds = seed_primes(math.isqrt(hi - 1))
+        try:
+            with self._lock:
+                if self._worker is None:
+                    self._worker = make_worker(self.config)
+                with trace.span("query.cold", lo=lo, hi=hi):
+                    res = self._worker.process_segment(lo, hi, seeds, seg_id=0)
+        except Degraded:
+            raise
+        except Exception as e:
+            with self._state_lock:
+                self._fail_streak += 1
+                tripped = self._fail_streak >= self.settings.breaker_fails
+                if tripped:
+                    self._down_until = max(
+                        self._down_until,
+                        trace.now_s() + self.settings.breaker_cooldown_s,
+                    )
+                    self._down_reason = f"breaker open ({e})"
+                    self._fail_streak = 0
+            self._update_health()
+            raise Degraded(f"cold backend error: {e}") from e
+        with self._state_lock:
+            self._fail_streak = 0
+        return int(res.count)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._worker is not None:
+                self._worker.close()
+                self._worker = None
+
+
+class _Flight:
+    """Single-flight slot: followers wait for the leader's result."""
+
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.value: int | None = None
+        self.error: Exception | None = None
+
+
+_STATS = (
+    "requests",
+    "index_hits",
+    "cold_computes",
+    "cold_cache_hits",
+    "coalesced",
+    "shed",
+    "deadline_exceeded",
+    "degraded_replies",
+    "bad_requests",
+    "internal_errors",
+)
+
+
+class SieveService:
+    """The persistent query server. See the module docstring for tiers."""
+
+    def __init__(
+        self,
+        config: "SieveConfig",
+        settings: ServiceSettings | None = None,
+        addr: str | None = None,
+    ):
+        self.config = config
+        self.settings = settings or ServiceSettings.from_env()
+        self._addr_req = addr or "127.0.0.1:0"
+        entries = {}
+        self.ledger = None
+        if config.checkpoint_dir:
+            self.ledger = Ledger.open_readonly(config)
+            entries = self.ledger.completed()
+        self.index = SieveIndex(
+            config.packing, entries, self.settings.lru_segments
+        )
+        self.metrics = MetricsLogger(config)
+        self.cold = ColdBackend(config, self.settings, self._on_degraded)
+        self.chaos = ChaosSchedule(config.chaos_directives())
+        self._cold_lock = threading.Lock()
+        self._cold_cache: dict[tuple[int, int], int] = {}
+        self._cold_order: list[tuple[int, int]] = []
+        self._inflight: dict[tuple[int, int], _Flight] = {}
+        self._queue: "queue.Queue" = queue.Queue(self.settings.queue_limit)
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._stats = {k: 0 for k in _STATS}
+        self._stats_lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self._listener: socket.socket | None = None
+        self._closing = False
+
+    # --- lifecycle -------------------------------------------------------
+
+    @property
+    def addr(self) -> str:
+        assert self._listener is not None, "service not started"
+        host, port = self._listener.getsockname()[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> "SieveService":
+        host, port = parse_addr(self._addr_req)
+        self._listener = socket.create_server((host, port))
+        self._listener.listen(64)
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="svc-accept")
+        t.start()
+        self._threads.append(t)
+        for i in range(self.settings.workers):
+            w = threading.Thread(target=self._worker_loop, daemon=True,
+                                 name=f"svc-worker-{i}")
+            w.start()
+            self._threads.append(w)
+        return self
+
+    def stop(self) -> None:
+        self._closing = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for _ in range(self.settings.workers):
+            try:
+                self._queue.put_nowait(None)
+            except queue.Full:
+                break
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+        self.cold.close()
+
+    def __enter__(self) -> "SieveService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # --- bookkeeping -----------------------------------------------------
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._stats[name] += n
+        registry().counter(f"service.{name}").inc(n)
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            out = dict(self._stats)
+        out.update(self.index.stats())
+        out["queue_depth"] = self._queue.qsize()
+        out["degraded"] = self.cold.degraded
+        return out
+
+    def _on_degraded(self, entering: bool, reason: str) -> None:
+        self.metrics.event("service_degraded", entering=entering,
+                           reason=reason)
+        registry().gauge("service.degraded").set(1.0 if entering else 0.0)
+
+    def inject_chaos(self, spec: str) -> int:
+        """Extend the live schedule (the ``chaos`` wire op / tests)."""
+        ds = parse_chaos(spec)
+        self.chaos.extend(ds)
+        return len(ds)
+
+    # --- network plumbing ------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._closing:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._conns_lock:
+                self._conns.add(conn)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._closing:
+                try:
+                    msg = recv_msg(conn)
+                except (OSError, ValueError):
+                    return
+                if msg is None:
+                    return
+                self._dispatch(conn, send_lock, msg)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _reply(self, conn: socket.socket, send_lock: threading.Lock,
+               payload: dict) -> None:
+        try:
+            with send_lock:
+                send_msg(conn, payload)
+        except OSError:
+            pass  # client went away; its outcome is already counted
+
+    def _dispatch(self, conn, send_lock, msg: dict) -> None:
+        mtype = msg.get("type")
+        rid = msg.get("id")
+        if mtype == "health":
+            # answered inline by the reader: health must stay observable
+            # under full-queue shed pressure and a dead backend alike
+            self._reply(conn, send_lock, {
+                "type": "health", "id": rid, "ok": True,
+                "status": "degraded" if self.cold.degraded else "ok",
+                "covered_hi": self.index.covered_hi,
+                "total_primes": self.index.total_primes,
+                "queue_depth": self._queue.qsize(),
+            })
+            return
+        if mtype == "stats":
+            self._reply(conn, send_lock,
+                        {"type": "stats", "id": rid, "ok": True,
+                         "stats": self.stats()})
+            return
+        if mtype == "chaos":
+            try:
+                n = self.inject_chaos(str(msg.get("spec", "")))
+            except ValueError as e:
+                self._reply(conn, send_lock,
+                            {"type": "reply", "id": rid, "ok": False,
+                             "error": "bad_request", "detail": str(e)})
+                return
+            self._reply(conn, send_lock,
+                        {"type": "reply", "id": rid, "ok": True,
+                         "injected": n})
+            return
+        if mtype != "query":
+            self._reply(conn, send_lock,
+                        {"type": "reply", "id": rid, "ok": False,
+                         "error": "bad_request",
+                         "detail": f"unknown message type {mtype!r}"})
+            return
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        directives = [
+            d for d in self.chaos.take(0, seq) if d["kind"] in SERVICE_KINDS
+        ]
+        op = str(msg.get("op", ""))
+        if any(d["kind"] == "svc_shed" for d in directives):
+            self._shed(conn, send_lock, rid, op, forced=True)
+            return
+        item = (msg, rid if rid is not None else seq, trace.now_s(),
+                directives, conn, send_lock)
+        try:
+            self._queue.put_nowait(item)
+        except queue.Full:
+            self._shed(conn, send_lock, rid, op, forced=False)
+            return
+        registry().gauge("service.queue_depth").set(self._queue.qsize())
+
+    def _shed(self, conn, send_lock, rid, op: str, forced: bool) -> None:
+        depth = self._queue.qsize()
+        self._bump("shed")
+        self.metrics.event("service_shed", quietable=True, op=op,
+                           queue_depth=depth)
+        detail = (
+            "shed by injected svc_shed fault" if forced
+            else f"admission queue full ({depth}/{self.settings.queue_limit})"
+        )
+        self._reply(conn, send_lock, {
+            "type": "reply", "id": rid, "ok": False, "op": op,
+            "error": "overloaded", "detail": detail,
+        })
+
+    # --- request handling ------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            registry().gauge("service.queue_depth").set(self._queue.qsize())
+            try:
+                self._handle(*item)
+            except Exception:
+                pass  # _handle replies "internal" itself; never die
+
+    def _handle(self, msg, rid, enq_t, directives, conn, send_lock) -> None:
+        op = str(msg.get("op", ""))
+        t_pop = trace.now_s()
+        trace.add_span("query.queue_wait", enq_t, t_pop - enq_t, op=op)
+        deadline = enq_t + float(
+            msg.get("deadline_s") or self.settings.default_deadline_s
+        )
+        ctx = QueryCtx()
+
+        def check() -> None:
+            if trace.now_s() > deadline:
+                raise DeadlineExceeded(ctx.answered_hi, ctx.count_so_far)
+
+        ctx.check = check
+        self._bump("requests")
+        outcome = "ok"
+        reply: dict = {"type": "reply", "id": rid, "ok": True, "op": op}
+        try:
+            for d in directives:
+                if d["kind"] == "svc_stall":
+                    time.sleep(float(d["param"] or 0.0))
+                elif d["kind"] == "backend_down":
+                    self.cold.force_down(float(d["param"] or 0.0),
+                                         "chaos backend_down")
+            check()
+            reply["value"] = self._execute(op, msg, ctx, deadline)
+        except tuple(_ERROR_KIND) as e:
+            outcome = _ERROR_KIND[type(e)]
+            reply = {
+                "type": "reply", "id": rid, "ok": False, "op": op,
+                "error": outcome, "detail": str(e),
+                "partial": self._partial(op, e),
+            }
+        except Exception as e:  # noqa: BLE001 — server must not die
+            outcome = "internal"
+            reply = {
+                "type": "reply", "id": rid, "ok": False, "op": op,
+                "error": "internal", "detail": f"{type(e).__name__}: {e}",
+                "partial": None,
+            }
+        t_end = trace.now_s()
+        source = ctx.source()
+        reply.setdefault("source", source)
+        reply["elapsed_ms"] = round((t_end - enq_t) * 1000, 3)
+        trace.add_span("rpc.query", enq_t, t_end - enq_t, op=op,
+                       outcome=outcome, source=source)
+        # counters/events before the reply: a stats call racing the
+        # reply must already see this request accounted for
+        if outcome == "ok" and not ctx.cold and not ctx.materialized:
+            self._bump("index_hits")
+        elif outcome == "deadline_exceeded":
+            self._bump("deadline_exceeded")
+        elif outcome == "degraded":
+            self._bump("degraded_replies")
+        elif outcome == "bad_request":
+            self._bump("bad_requests")
+        elif outcome == "internal":
+            self._bump("internal_errors")
+        self.metrics.event(
+            "service_request", quietable=True, op=op, outcome=outcome,
+            source=source, ms=reply["elapsed_ms"],
+        )
+        self._reply(conn, send_lock, reply)
+
+    @staticmethod
+    def _partial(op: str, e: Exception) -> dict | None:
+        if not isinstance(e, DeadlineExceeded):
+            return None
+        if op == "pi":
+            return {"answered_hi": e.answered_hi, "pi_so_far": e.count_so_far}
+        if op == "nth_prime":
+            return {"searched_hi": e.answered_hi,
+                    "count_so_far": e.count_so_far}
+        return {"answered_hi": e.answered_hi, "count_so_far": e.count_so_far}
+
+    # --- ops -------------------------------------------------------------
+
+    def _execute(self, op: str, msg: dict, ctx: QueryCtx, deadline: float):
+        if op == "pi":
+            x = _req_int(msg, "x")
+            if x < 0 or x + 1 > MAX_HI:
+                raise BadRequest(f"pi({x}): x must be in [0, {MAX_HI})")
+            return self._count_upto(x + 1, ctx, deadline)
+        if op == "count":
+            lo, hi = _req_int(msg, "lo"), _req_int(msg, "hi")
+            kind = str(msg.get("kind", "primes"))
+            return self._count(lo, hi, kind, ctx, deadline)
+        if op == "nth_prime":
+            return self._nth_prime(_req_int(msg, "k"), ctx, deadline)
+        if op == "primes":
+            lo, hi = _req_int(msg, "lo"), _req_int(msg, "hi")
+            return self._primes(lo, hi, ctx, deadline)
+        raise BadRequest(
+            f"unknown op {op!r} (one of pi, count, nth_prime, primes)"
+        )
+
+    def _count_upto(self, v: int, ctx: QueryCtx, deadline: float) -> int:
+        """Primes in [2, v): index prefix + cold chunks past covered_hi."""
+        if v <= 2:
+            return 0
+        covered = min(v, self.index.covered_hi)
+        total = self.index.count_upto(covered, ctx)
+        a = covered
+        while a < v:
+            ctx.tick()
+            b = min(_grid_next(a, self.settings.cold_chunk), v)
+            total += self._cold_count(a, b, ctx, deadline)
+            a = b
+            ctx.answered_hi = max(ctx.answered_hi, a)
+            ctx.count_so_far = max(ctx.count_so_far, total)
+        return total
+
+    def _count(self, lo: int, hi: int, kind: str,
+               ctx: QueryCtx, deadline: float) -> int:
+        if hi > MAX_HI:
+            raise BadRequest(f"count: hi={hi} exceeds {MAX_HI}")
+        if hi < lo:
+            raise BadRequest(f"count: hi={hi} < lo={lo}")
+        if kind == "primes":
+            c_lo = self._count_upto(lo, ctx, deadline)
+            return self._count_upto(hi, ctx, deadline) - c_lo
+        if kind in ("twins", "cousins"):
+            gap = 2 if kind == "twins" else 4
+            if hi - lo > self.settings.max_pair_span:
+                raise BadRequest(
+                    f"count kind={kind}: span {hi - lo} exceeds "
+                    f"{self.settings.max_pair_span} (pair counts enumerate)"
+                )
+            a = self._collect_primes(lo, hi, ctx, deadline, cap=None)
+            return _pairs(a, gap)
+        raise BadRequest(
+            f"count: unknown kind {kind!r} (primes, twins, cousins)"
+        )
+
+    def _nth_prime(self, k: int, ctx: QueryCtx, deadline: float) -> int:
+        if k < 1:
+            raise BadRequest(f"nth_prime({k}): k must be >= 1")
+        if k <= self.index.total_primes:
+            return self.index.nth(k, ctx)
+        # extend past the index: cold-count the fixed grid until the
+        # containing chunk, then materialize just that chunk locally
+        seen = self.index.total_primes
+        ctx.index = bool(self.index.segments)
+        ctx.count_so_far = max(ctx.count_so_far, seen)
+        a = self.index.covered_hi
+        while True:
+            ctx.tick()
+            if a >= MAX_HI:
+                raise BadRequest(
+                    f"nth_prime({k}): search passed MAX_HI={MAX_HI} "
+                    f"with only {seen} primes"
+                )
+            b = min(_grid_next(a, self.settings.cold_chunk), MAX_HI)
+            c = self._cold_count(a, b, ctx, deadline)
+            if seen + c >= k:
+                return self._nth_in_window(a, b, k - seen, ctx)
+            seen += c
+            a = b
+            ctx.answered_hi = max(ctx.answered_hi, a)
+            ctx.count_so_far = max(ctx.count_so_far, seen)
+
+    def _nth_in_window(self, lo: int, hi: int, r: int, ctx: QueryCtx) -> int:
+        """r-th prime (1-indexed) inside [lo, hi) — r is known to exist."""
+        layout = self.index.layout
+        extras = [p for p in layout.extra_primes if lo <= p < hi]
+        if r <= len(extras):
+            return extras[r - 1]
+        r -= len(extras)
+        flags = self.index.get_flags(lo, hi, ctx)
+        pos = np.nonzero(flags)[0][r - 1]
+        return int(layout.values_np(lo, np.array([pos]))[0])
+
+    def _primes(self, lo: int, hi: int, ctx: QueryCtx,
+                deadline: float) -> list[int]:
+        if hi > MAX_HI:
+            raise BadRequest(f"primes: hi={hi} exceeds {MAX_HI}")
+        if hi < lo:
+            raise BadRequest(f"primes: hi={hi} < lo={lo}")
+        a = self._collect_primes(lo, hi, ctx, deadline,
+                                 cap=self.settings.max_primes)
+        return [int(p) for p in a]
+
+    def _collect_primes(self, lo: int, hi: int, ctx: QueryCtx,
+                        deadline: float, cap: int | None) -> np.ndarray:
+        """Materialize primes in [lo, hi) through the enumerate seam,
+        feeding hot slices from the index LRU (``flags_fn``) and marking
+        the request cold when a slice falls past the covered range."""
+        lo = max(lo, 2)
+        if hi <= lo:
+            return np.zeros(0, dtype=np.int64)
+        last_slice = [lo]
+
+        def flags_fn(slo: int, shi: int):
+            last_slice[0] = shi
+            f = self.index.flags_for_slice(slo, shi, ctx)
+            if f is None:
+                ctx.cold = True
+                self._bump("cold_computes")
+            return f
+
+        out: list[np.ndarray] = []
+        count = 0
+        try:
+            gen = primes_in_range(self.config.packing, lo, hi,
+                                  bounds=self.index.bounds, flags_fn=flags_fn)
+        except ValueError as e:
+            raise BadRequest(str(e)) from None
+        for arr in gen:
+            out.append(arr)
+            count += arr.size
+            ctx.answered_hi = max(ctx.answered_hi, last_slice[0])
+            ctx.count_so_far = max(ctx.count_so_far, count)
+            if cap is not None and count > cap:
+                raise BadRequest(
+                    f"primes: result exceeds {cap} values at "
+                    f"{last_slice[0]}; narrow the window or raise "
+                    f"SIEVE_SVC_MAX_PRIMES"
+                )
+            ctx.tick()
+        return (np.concatenate(out) if out
+                else np.zeros(0, dtype=np.int64))
+
+    # --- cold tier: single-flight + result cache -------------------------
+
+    def _cold_count(self, lo: int, hi: int, ctx: QueryCtx,
+                    deadline: float) -> int:
+        key = (lo, hi)
+        with self._cold_lock:
+            cached = self._cold_cache.get(key)
+            if cached is not None:
+                ctx.cold_cached = True
+                self._bump("cold_cache_hits")
+                return cached
+            flight = self._inflight.get(key)
+            leader = flight is None
+            if leader:
+                flight = self._inflight[key] = _Flight()
+        if not leader:
+            # follower: coalesce onto the in-flight computation
+            self._bump("coalesced")
+            self.metrics.event("service_coalesced", quietable=True,
+                               op="count_range", lo=lo, hi=hi)
+            if not flight.event.wait(timeout=max(0.0,
+                                                 deadline - trace.now_s())):
+                raise DeadlineExceeded(ctx.answered_hi, ctx.count_so_far)
+            if flight.error is not None:
+                if isinstance(flight.error, Degraded):
+                    raise Degraded(str(flight.error))
+                raise RuntimeError(f"coalesced compute failed: "
+                                   f"{flight.error}") from flight.error
+            ctx.cold = True
+            assert flight.value is not None
+            return flight.value
+        try:
+            ctx.cold = True
+            self._bump("cold_computes")
+            value = self.cold.count_range(lo, hi)
+        except Exception as e:
+            flight.error = e
+            raise
+        else:
+            flight.value = value
+            with self._cold_lock:
+                self._cold_cache[key] = value
+                self._cold_order.append(key)
+                while len(self._cold_order) > self.settings.cold_cache_entries:
+                    old = self._cold_order.pop(0)
+                    self._cold_cache.pop(old, None)
+            return value
+        finally:
+            flight.event.set()
+            with self._cold_lock:
+                self._inflight.pop(key, None)
+
+
+def _grid_next(a: int, chunk: int) -> int:
+    """Next cold-chunk boundary strictly above ``a`` on the fixed grid —
+    overlapping queries land on identical (lo, hi) keys and coalesce."""
+    return (a // chunk + 1) * chunk
+
+
+def _req_int(msg: dict, field: str) -> int:
+    v = msg.get(field)
+    if not isinstance(v, int) or isinstance(v, bool):
+        raise BadRequest(f"field {field!r} must be an integer, got {v!r}")
+    return v
+
+
+def _pairs(primes: np.ndarray, gap: int) -> int:
+    """Pairs (p, p+gap) with both members present in the sorted array."""
+    if primes.size < 2:
+        return 0
+    idx = np.searchsorted(primes, primes + gap)
+    ok = idx < primes.size
+    return int(np.count_nonzero(primes[idx[ok]] == primes[ok] + gap))

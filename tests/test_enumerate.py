@@ -6,8 +6,35 @@ import numpy as np
 import pytest
 
 from sieve.cli import main
-from sieve.enumerate import primes_in_range
+from sieve.enumerate import _SLICE, MAX_HI, primes_in_range
 from sieve.seed import seed_primes
+
+_MR_BASES = (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37)
+
+
+def _is_prime(n: int) -> bool:
+    # deterministic Miller-Rabin for n < 3.3e24 (bases 2..37) — an oracle
+    # independent of every sieve in the repo, cheap at any offset
+    if n < 2:
+        return False
+    for p in _MR_BASES:
+        if n % p == 0:
+            return n == p
+    d, r = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for a in _MR_BASES:
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = x * x % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
 
 
 def _collect(packing, lo, hi):
@@ -46,6 +73,42 @@ def test_enumerate_spans_internal_slices():
 def test_enumerate_span_cap():
     with pytest.raises(ValueError):
         list(primes_in_range("odds", 2, 2 * 10**9 + 10))
+
+
+@pytest.mark.parametrize("packing", ["plain", "odds", "wheel30"])
+def test_enumerate_empty_and_sub_extra_windows(packing):
+    # lo == hi at various offsets: always empty, never an error
+    for v in (0, 2, 7, 10_000):
+        assert _collect(packing, v, v).size == 0
+    # windows entirely below the first prime (and below every layout
+    # extra) — [0, 1) and [0, 2) must be empty for all packings
+    assert _collect(packing, 0, 1).size == 0
+    assert _collect(packing, 0, 2).size == 0
+    np.testing.assert_array_equal(_collect(packing, 0, 3), [2])
+
+
+@pytest.mark.parametrize("packing", ["plain", "odds", "wheel30"])
+def test_enumerate_window_ending_exactly_at_max_hi(packing):
+    # the documented ceiling itself must work: [MAX_HI - 200, MAX_HI)
+    lo, hi = MAX_HI - 200, MAX_HI
+    got = _collect(packing, lo, hi)
+    want = [v for v in range(lo, hi) if _is_prime(v)]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_enumerate_beyond_max_hi_raises():
+    with pytest.raises(ValueError, match="seed sieve"):
+        primes_in_range("odds", MAX_HI - 10, MAX_HI + 1)
+
+
+@pytest.mark.parametrize("packing", ["plain", "odds", "wheel30"])
+def test_enumerate_straddles_slice_boundary(packing):
+    # a window crossing the internal _SLICE cut must not drop/duplicate
+    # primes at the seam
+    lo, hi = _SLICE - 60, _SLICE + 60
+    got = _collect(packing, lo, hi)
+    want = [v for v in range(lo, hi) if _is_prime(v)]
+    np.testing.assert_array_equal(got, want)
 
 
 def test_cli_emit_primes(capsys):

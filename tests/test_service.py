@@ -1,0 +1,501 @@
+"""The query service: index tier, admission, degradation, chaos (ISSUE 7).
+
+Covers: Ledger.open_readonly (never flushes, never quarantines, refuses
+corruption and foreign configs); seed_primes memoization (bit-exact,
+immutable); SieveIndex exactness including hole-dropping; every wire op
+against a cpu-numpy oracle over real TCP; typed overloaded /
+deadline_exceeded / degraded outcomes (no silent hangs, no wrong
+answers); single-flight coalescing; breaker recovery; the service chaos
+grammar; EVENT_SCHEMA validation of the service_* events; rpc.query
+spans rendered by trace_report; the enumerate flags_fn seam; the
+service_smoke tool and the ``serve`` CLI as tier-1 subprocess tests.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sieve import metrics, trace
+from sieve.backends.cpu_numpy import sieve_segment_flags
+from sieve.chaos import ANY_WORKER, parse_chaos
+from sieve.checkpoint import LEDGER_NAME, Ledger, LedgerCorrupt, LedgerMismatch
+from sieve.config import SieveConfig
+from sieve.coordinator import run_local
+from sieve.enumerate import primes_in_range
+from sieve.metrics import MemorySink, validate_record
+from sieve.seed import _seed_primes_uncached, seed_cache_clear, seed_primes
+from sieve.service import (
+    QueryCtx,
+    ServiceClient,
+    ServiceSettings,
+    SieveIndex,
+    SieveService,
+)
+
+REPO = Path(__file__).parent.parent
+N = 50_000
+ORACLE_HI = 200_000
+
+
+@pytest.fixture
+def memsink():
+    sink = MemorySink()
+    metrics.add_sink(sink)
+    yield sink
+    metrics.remove_sink(sink)
+
+
+@pytest.fixture(scope="module")
+def ledger_dir(tmp_path_factory):
+    """A sieved checkpoint dir shared by the service tests (read-only)."""
+    path = tmp_path_factory.mktemp("svc_ledger")
+    cfg = _cfg(str(path))
+    run_local(cfg)
+    return path
+
+
+def _cfg(checkpoint_dir: str, **kw) -> SieveConfig:
+    base = dict(
+        n=N, backend="cpu-numpy", packing="wheel30", n_segments=4,
+        quiet=True, checkpoint_dir=checkpoint_dir,
+    )
+    base.update(kw)
+    return SieveConfig(**base)
+
+
+def _settings(**kw) -> ServiceSettings:
+    base = dict(
+        workers=2, queue_limit=16, default_deadline_s=10.0,
+        cold_chunk=1 << 16, breaker_cooldown_s=0.4,
+    )
+    base.update(kw)
+    return ServiceSettings(**base)
+
+
+@pytest.fixture
+def service(ledger_dir):
+    with SieveService(_cfg(str(ledger_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            yield svc, cli
+
+
+P = seed_primes(ORACLE_HI)
+
+
+def o_pi(x):
+    return int(np.searchsorted(P, x, side="right"))
+
+
+def o_count(lo, hi):
+    return int(np.searchsorted(P, hi, side="left")
+               - np.searchsorted(P, lo, side="left"))
+
+
+def o_pairs(lo, hi, gap):
+    w = P[(P >= lo) & (P < hi)]
+    idx = np.searchsorted(w, w + gap)
+    ok = idx < w.size
+    return int(np.count_nonzero(w[idx[ok]] == w[ok] + gap))
+
+
+# --- Ledger.open_readonly (satellite a) --------------------------------------
+
+
+def test_open_readonly_snapshot_never_flushes(ledger_dir):
+    path = ledger_dir / LEDGER_NAME
+    before = path.read_text()
+    led = Ledger.open_readonly(_cfg(str(ledger_dir)))
+    assert led.read_only
+    assert len(led.completed()) == 4
+    with pytest.raises(LedgerMismatch, match="read-only"):
+        led.record(next(iter(led.completed().values())))
+    assert path.read_text() == before  # byte-identical: nothing rewritten
+
+
+def test_open_readonly_missing_ledger_is_empty(tmp_path):
+    led = Ledger.open_readonly(_cfg(str(tmp_path)))
+    assert led.read_only
+    assert led.completed() == {}
+
+
+def test_open_readonly_refuses_corruption_without_quarantine(
+    tmp_path, ledger_dir
+):
+    src = (ledger_dir / LEDGER_NAME).read_text()
+    path = tmp_path / LEDGER_NAME
+    path.write_text(src[: int(len(src) * 0.6)])  # torn write
+    damaged = path.read_text()
+    with pytest.raises(LedgerCorrupt, match="read-only|refusing"):
+        Ledger.open_readonly(_cfg(str(tmp_path)))
+    # unlike Ledger.open: the evidence is untouched, nothing quarantined
+    assert path.read_text() == damaged
+    assert not os.path.exists(str(path) + ".quarantined")
+
+
+def test_open_readonly_refuses_foreign_config(ledger_dir):
+    with pytest.raises(LedgerMismatch):
+        Ledger.open_readonly(_cfg(str(ledger_dir), n=2 * N))
+
+
+# --- seed memoization (satellite b) ------------------------------------------
+
+
+def test_seed_primes_memoized_and_bit_exact():
+    seed_cache_clear()
+    a = seed_primes(10_000)
+    assert seed_primes(10_000) is a  # cache hit returns the same array
+    np.testing.assert_array_equal(a, _seed_primes_uncached(10_000))
+    np.testing.assert_array_equal(
+        seed_primes(9_973), _seed_primes_uncached(9_973)
+    )
+    assert not a.flags.writeable
+    with pytest.raises(ValueError):
+        a[0] = 4  # cached arrays are immutable: no cross-caller poisoning
+
+
+# --- the index tier ----------------------------------------------------------
+
+
+def test_index_prefix_counts_and_nth_exact(ledger_dir):
+    led = Ledger.open_readonly(_cfg(str(ledger_dir)))
+    idx = SieveIndex("wheel30", led.completed())
+    assert idx.dropped_segments == 0
+    assert idx.total_primes == o_pi(idx.covered_hi - 1)
+    for v in [2, 3, 100, 12_345] + idx.bounds:
+        assert idx.count_upto(v, QueryCtx()) == o_pi(v - 1), v
+    for k in (1, 2, 3, 100, idx.total_primes):
+        assert idx.nth(k, QueryCtx()) == int(P[k - 1]), k
+    # repeat of an interior count is served from the LRU, not re-sieved
+    s0 = idx.stats()
+    idx.count_upto(12_345, QueryCtx())
+    s1 = idx.stats()
+    assert s1["lru_hits"] > s0["lru_hits"]
+    assert s1["materialized"] == s0["materialized"]
+
+
+def test_index_drops_segments_after_a_hole(ledger_dir):
+    led = Ledger.open_readonly(_cfg(str(ledger_dir)))
+    segs = sorted(led.completed().values(), key=lambda r: r.lo)
+    holed = [segs[0]] + segs[2:]  # lose segment 1: 2 and 3 are unanchored
+    idx = SieveIndex("wheel30", holed)
+    assert len(idx.segments) == 1
+    assert idx.dropped_segments == 2
+    assert idx.covered_hi == segs[0].hi
+    with pytest.raises(ValueError, match="beyond covered_hi"):
+        idx.count_upto(segs[2].hi, QueryCtx())
+
+
+# --- wire ops vs oracle ------------------------------------------------------
+
+
+def test_ops_exact_over_tcp(service):
+    svc, cli = service
+    covered = svc.index.covered_hi
+    assert cli.pi(0) == 0
+    assert cli.pi(2) == 1
+    assert cli.pi(30_000) == o_pi(30_000)          # hot interior
+    assert cli.pi(covered - 1) == o_pi(covered - 1)  # hot boundary
+    assert cli.pi(90_000) == o_pi(90_000)          # cold
+    assert cli.count(10_000, 40_000) == o_count(10_000, 40_000)
+    assert cli.count(40_000, 90_000) == o_count(40_000, 90_000)  # straddle
+    assert cli.count(7, 7) == 0
+    assert cli.count(2, 40_000, "twins") == o_pairs(2, 40_000, 2)
+    assert cli.count(2, 40_000, "cousins") == o_pairs(2, 40_000, 4)
+    assert cli.count(45_000, 55_000, "twins") == o_pairs(45_000, 55_000, 2)
+    assert cli.nth_prime(1) == 2
+    assert cli.nth_prime(1000) == int(P[999])
+    beyond = svc.index.total_primes + 50
+    assert cli.nth_prime(beyond) == int(P[beyond - 1])
+    want = P[(P >= 49_990) & (P < 50_050)]
+    assert cli.primes(49_990, 50_050) == [int(v) for v in want]
+
+
+def test_bad_requests_are_typed(service):
+    _, cli = service
+    for msg in [
+        {"op": "pi", "x": "nope"},
+        {"op": "pi", "x": True},
+        {"op": "count", "lo": 9, "hi": 4},
+        {"op": "count", "lo": 2, "hi": 9, "kind": "sexy"},
+        {"op": "nth_prime", "k": 0},
+        {"op": "frobnicate"},
+    ]:
+        r = cli.query(**msg)
+        assert not r.get("ok"), msg
+        assert r["error"] == "bad_request", (msg, r)
+
+
+def test_repeated_hot_query_is_an_index_hit(service):
+    svc, cli = service
+    want = o_pi(30_000)
+    assert cli.pi(30_000) == want  # may materialize the chunk once
+    s0 = cli.stats()
+    for _ in range(3):
+        assert cli.pi(30_000) == want
+    s1 = cli.stats()
+    assert s1["index_hits"] - s0["index_hits"] >= 3
+    assert s1["cold_computes"] == s0["cold_computes"]
+    assert s1["materialized"] == s0["materialized"]
+
+
+# --- admission: shed + deadline ----------------------------------------------
+
+
+def test_queue_saturation_sheds_typed_never_hangs(ledger_dir):
+    settings = _settings(workers=1, queue_limit=1, cold_delay_s=0.4)
+    with SieveService(_cfg(str(ledger_dir)), settings) as svc:
+        replies = []
+        lock = threading.Lock()
+
+        def fire():
+            with ServiceClient(svc.addr, timeout_s=30) as c:
+                r = c.query("pi", x=90_000)
+                with lock:
+                    replies.append(r)
+
+        threads = [threading.Thread(target=fire) for _ in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not any(t.is_alive() for t in threads), "silent hang"
+        assert len(replies) == 5
+        shed = [r for r in replies if not r.get("ok")]
+        assert shed, "queue_limit=1 with 5 concurrent colds never shed"
+        for r in shed:
+            assert r["error"] == "overloaded"
+            assert "detail" in r
+        for r in replies:
+            if r.get("ok"):
+                assert r["value"] == o_pi(90_000)
+
+
+def test_injected_shed_and_stall_deadline(service, memsink):
+    svc, cli = service
+    svc.inject_chaos(f"svc_shed:any@s{svc._seq + 1}")
+    r = cli.query("pi", x=100)
+    assert r["error"] == "overloaded"
+    assert "svc_shed" in r["detail"]
+    # a stall past the request deadline: typed deadline_exceeded with the
+    # partial prefix answered so far — not a hang, not a wrong answer
+    svc.inject_chaos(f"svc_stall:any@s{svc._seq + 1}:0.6")
+    r = cli.query("pi", deadline_s=0.2, x=30_000)
+    assert r["error"] == "deadline_exceeded"
+    assert isinstance(r["partial"], dict)
+    assert r["partial"]["answered_hi"] >= 2
+    # a stall shorter than the deadline: the answer is still exact
+    svc.inject_chaos(f"svc_stall:any@s{svc._seq + 1}:0.05")
+    assert cli.pi(30_000, deadline_s=5.0) == o_pi(30_000)
+    shed = [x for x in memsink.records if x["event"] == "service_shed"]
+    assert shed and shed[0]["op"] == "pi"
+    for x in memsink.records:
+        validate_record(x)
+
+
+# --- cold tier: coalescing + degradation -------------------------------------
+
+
+def test_overlapping_cold_queries_coalesce(ledger_dir):
+    settings = _settings(workers=4, cold_delay_s=0.3)
+    with SieveService(_cfg(str(ledger_dir)), settings) as svc:
+        got, errs = [], []
+
+        def q():
+            try:
+                with ServiceClient(svc.addr, timeout_s=30) as c:
+                    got.append(c.pi(90_000))
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        t1, t2 = threading.Thread(target=q), threading.Thread(target=q)
+        t1.start()
+        time.sleep(0.1)  # inside the leader's simulated 0.3 s compute
+        t2.start()
+        t1.join(30)
+        t2.join(30)
+        assert not errs
+        assert got == [o_pi(90_000)] * 2
+        with ServiceClient(svc.addr) as cli:
+            s = cli.stats()
+            assert s["coalesced"] >= 1
+            # the leader's results are cached: a repeat is answered
+            # without another backend call
+            c0 = s["cold_computes"]
+            assert cli.pi(90_000) == o_pi(90_000)
+            s2 = cli.stats()
+            assert s2["cold_computes"] == c0
+            assert s2["cold_cache_hits"] > s["cold_cache_hits"]
+
+
+def test_backend_down_keeps_hot_index_up(service, memsink):
+    svc, cli = service
+    svc.inject_chaos(f"backend_down:any@s{svc._seq + 1}:0.6")
+    r = cli.query("pi", x=90_000)  # needs a fresh cold chunk
+    assert r["error"] == "degraded"
+    assert cli.health()["status"] == "degraded"
+    assert cli.pi(30_000) == o_pi(30_000)  # hot tier unaffected, exact
+    deadline = time.monotonic() + 10
+    while cli.health()["status"] != "ok":
+        assert time.monotonic() < deadline, "never recovered"
+        time.sleep(0.05)
+    assert cli.pi(90_000) == o_pi(90_000)  # cold tier healed, exact
+    deg = [x for x in memsink.records if x["event"] == "service_degraded"]
+    assert [d["entering"] for d in deg] == [True, False]
+    for d in deg:
+        validate_record(d)
+
+
+def test_breaker_opens_after_fail_streak(ledger_dir):
+    settings = _settings(breaker_fails=2, breaker_cooldown_s=0.3)
+    with SieveService(_cfg(str(ledger_dir)), settings) as svc:
+        calls = []
+
+        def boom(lo, hi, seeds, seg_id=0):
+            calls.append(lo)
+            raise RuntimeError("backend on fire")
+
+        svc.cold._worker = type(
+            "W", (), {"process_segment": staticmethod(boom),
+                      "close": staticmethod(lambda: None)}
+        )()
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            assert cli.query("pi", x=90_000)["error"] == "degraded"
+            assert cli.query("pi", x=90_000)["error"] == "degraded"
+            n = len(calls)
+            # breaker is open: the next cold query fails fast without
+            # touching the broken backend; hot queries still exact
+            r = cli.query("pi", x=90_000)
+            assert r["error"] == "degraded"
+            assert "breaker" in r["detail"]
+            assert len(calls) == n
+            assert cli.pi(30_000) == o_pi(30_000)
+
+
+# --- chaos grammar for the service kinds -------------------------------------
+
+
+def test_parse_service_chaos_kinds():
+    ds = parse_chaos(
+        "svc_stall:any@s3:0.5,svc_shed:any@s4,backend_down:any@s2:1.5"
+    )
+    assert [(d.kind, d.worker, d.seg_id, d.param) for d in ds] == [
+        ("svc_stall", ANY_WORKER, 3, 0.5),
+        ("svc_shed", ANY_WORKER, 4, None),
+        ("backend_down", ANY_WORKER, 2, 1.5),
+    ]
+    assert parse_chaos("svc_stall:any@s1")[0].param == 1.0
+    assert parse_chaos("backend_down:any@s1")[0].param == 1.0
+    with pytest.raises(ValueError, match="svc_shed takes no param"):
+        parse_chaos("svc_shed:any@s1:2.0")
+
+
+def test_cluster_ignores_service_kinds(ledger_dir):
+    # a service directive in a cluster run must parse (one schedule, two
+    # planes) and simply never fire worker-side
+    cfg = _cfg(str(ledger_dir), chaos="svc_stall:any@s1:9")
+    assert [d.kind for d in cfg.chaos_directives()] == ["svc_stall"]
+
+
+# --- observability: events + spans + report ----------------------------------
+
+
+def test_service_events_validate_and_spans_render(service, memsink):
+    svc, cli = service
+    tr = trace.get_tracer()
+    tr.enable()
+    try:
+        assert cli.pi(30_000) == o_pi(30_000)
+        assert cli.pi(90_000) == o_pi(90_000)  # forces a query.cold span
+        cli.query("pi", x="bad")
+    finally:
+        tr.disable()
+    reqs = [x for x in memsink.records if x["event"] == "service_request"]
+    assert len(reqs) == 3
+    assert {r["outcome"] for r in reqs} == {"ok", "bad_request"}
+    assert {r["source"] for r in reqs} >= {"index"}
+    for x in memsink.records:
+        validate_record(x)
+
+    from tools.trace_report import report, service_report
+
+    spans = [e for e in tr.events() if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    assert {"rpc.query", "query.queue_wait", "query.cold"} <= names
+    text = "\n".join(service_report(spans))
+    assert "query service" in text
+    assert "queue-wait" in text and "cold compute" in text
+    assert "index" in text
+    assert "query service" in report(spans)  # wired into the full report
+
+
+def test_flags_fn_seam_matches_local_sieve(ledger_dir):
+    # the exact seam the service uses: bounds + flags_fn, with one slice
+    # fed from a precomputed bitset and the rest falling back to None
+    led = Ledger.open_readonly(_cfg(str(ledger_dir)))
+    idx = SieveIndex("wheel30", led.completed())
+    seg = idx.segments[1]
+    pre = sieve_segment_flags(
+        "wheel30", seg.lo, seg.hi, seed_primes(300)
+    )
+    served = []
+
+    def flags_fn(slo, shi):
+        if (slo, shi) == (seg.lo, seg.hi):
+            served.append((slo, shi))
+            return pre
+        return None
+
+    got = np.concatenate(list(primes_in_range(
+        "wheel30", 2, idx.covered_hi, bounds=idx.bounds, flags_fn=flags_fn
+    )))
+    want = np.concatenate(list(primes_in_range("wheel30", 2, idx.covered_hi)))
+    np.testing.assert_array_equal(got, want)
+    assert served == [(seg.lo, seg.hi)]  # the seam was actually exercised
+
+
+# --- subprocess gates: smoke tool + serve CLI --------------------------------
+
+
+def test_service_smoke_tool(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "service_smoke.py"),
+         "--keep", str(tmp_path / "work")],
+        env=env, cwd=str(REPO), capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "SERVICE_SMOKE_OK" in proc.stdout
+
+
+def test_serve_cli_end_to_end(ledger_dir):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sieve", "serve",
+         "--addr", "127.0.0.1:0", "--n", str(N), "--segments", "4",
+         "--packing", "wheel30", "--checkpoint-dir", str(ledger_dir),
+         "--quiet"],
+        env=env, cwd=str(REPO), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        head = json.loads(line)
+        assert head["event"] == "serving"
+        assert head["segments"] == 4
+        with ServiceClient(head["addr"], timeout_s=30) as cli:
+            assert cli.pi(30_000) == o_pi(30_000)
+            assert cli.health()["status"] == "ok"
+            r = cli.query("count", lo=9, hi=4)
+            assert r["error"] == "bad_request"
+    finally:
+        proc.send_signal(signal.SIGINT)
+        out, err = proc.communicate(timeout=30)
+    assert proc.returncode == 0, (out, err)

@@ -120,6 +120,11 @@ def report(spans: list[dict], top: int = 10) -> str:
             "(device never starved, or not a mesh run)"
         )
 
+    service = service_report(spans)
+    if service:
+        lines.append("")
+        lines.extend(service)
+
     lines.append("")
     lines.append(f"slowest {min(top, len(spans))} spans:")
     for e in sorted(spans, key=lambda e: -e["dur"])[:top]:
@@ -127,6 +132,57 @@ def report(spans: list[dict], top: int = 10) -> str:
             f"  {e['dur'] / 1e3:>10.3f} ms  {e['name']}{_fmt_args(e)}"
         )
     return "\n".join(lines)
+
+
+def service_report(spans: list[dict]) -> list[str]:
+    """Query-service section: per-request latency decomposed into
+    queue-wait vs index materialization vs cold backend compute, split
+    by reply source (sieve/service/ rpc.query spans). Empty when the
+    trace has no service traffic."""
+    rpc = [e for e in spans if e["name"] == "rpc.query"]
+    if not rpc:
+        return []
+    lines = ["query service (rpc.query requests):"]
+    by_outcome: dict[tuple[str, str], list[float]] = {}
+    for e in rpc:
+        a = e.get("args", {})
+        key = (str(a.get("op", "?")), str(a.get("outcome", "?")),
+               str(a.get("source", "?")))
+        by_outcome.setdefault(key, []).append(e["dur"])
+    lines.append(
+        f"  {'op':<10} {'outcome':<18} {'source':<7} {'count':>6} "
+        f"{'total ms':>10} {'mean ms':>9} {'max ms':>9}"
+    )
+    for (op, outcome, source), durs in sorted(
+        by_outcome.items(), key=lambda kv: -sum(kv[1])
+    ):
+        lines.append(
+            f"  {op:<10} {outcome:<18} {source:<7} {len(durs):>6} "
+            f"{sum(durs) / 1e3:>10.3f} {sum(durs) / len(durs) / 1e3:>9.3f} "
+            f"{max(durs) / 1e3:>9.3f}"
+        )
+    total = sum(e["dur"] for e in rpc)
+    parts = [
+        ("queue-wait", "query.queue_wait"),
+        ("index materialize", "query.materialize"),
+        ("cold compute", "query.cold"),
+    ]
+    lines.append(
+        f"  latency split over {len(rpc)} requests "
+        f"({total / 1e3:.3f} ms total in rpc.query):"
+    )
+    accounted = 0.0
+    for label, name in parts:
+        t = sum(e["dur"] for e in spans if e["name"] == name)
+        accounted += t
+        pct = 100 * t / total if total else 0.0
+        lines.append(f"    {label:<18} {t / 1e3:>10.3f} ms {pct:>6.1f}%")
+    other = max(0.0, total - accounted)
+    lines.append(
+        f"    {'index/other':<18} {other / 1e3:>10.3f} ms "
+        f"{100 * other / total if total else 0:>6.1f}%"
+    )
+    return lines
 
 
 def cluster_report(events: list[dict], top: int = 10) -> str:
