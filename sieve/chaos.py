@@ -39,6 +39,23 @@ handler thread drawing it — ``any`` is the deterministic choice):
                               K — hot-index queries must keep answering
                               while health degrades.
 
+Replication kinds (ISSUE 8; the live-follow / failover / drain plane):
+
+* ``svc_refresh_corrupt:any@sK`` the K-th ledger *refresh attempt* (not
+                              request) is forced to fail — the follower
+                              must skip the swap with a typed
+                              ``service_refresh_failed`` event and keep
+                              serving the previous snapshot.
+* ``replica_down:any@sK:secs`` starting at request K the replica drops
+                              every connection without a reply for
+                              ``secs`` (default 1.0) — a dead replica
+                              from the client's side; a ReplicaSet must
+                              fail over, never return a wrong number.
+* ``svc_drain:any@sK``        request K flips the server to draining
+                              (as SIGTERM would): the request itself and
+                              all later ones are shed as typed
+                              ``draining`` while queued work completes.
+
 ``worker`` is an integer id, or ``any``/``*`` for whichever worker draws
 the segment (the pull model makes a specific id probabilistic, ``any``
 deterministic). Directives are transported to the worker inside the
@@ -66,10 +83,29 @@ KINDS = (
     "svc_stall",
     "svc_shed",
     "backend_down",
+    "svc_refresh_corrupt",
+    "replica_down",
+    "svc_drain",
 )
 # kinds handled by the query service (sieve/service/); the cluster plane
-# ignores these and vice versa
-SERVICE_KINDS = ("svc_stall", "svc_shed", "backend_down")
+# ignores these and vice versa. Request-scoped kinds key on the request
+# sequence number; svc_refresh_corrupt keys on the refresh attempt
+# number and is drawn by the LedgerFollower, not the dispatcher.
+SERVICE_KINDS = (
+    "svc_stall",
+    "svc_shed",
+    "backend_down",
+    "svc_refresh_corrupt",
+    "replica_down",
+    "svc_drain",
+)
+SERVICE_REQUEST_KINDS = (
+    "svc_stall",
+    "svc_shed",
+    "backend_down",
+    "replica_down",
+    "svc_drain",
+)
 # default param (seconds) for kinds that take one; None = no param
 DEFAULT_PARAM: dict[str, float | None] = {
     "kill": None,
@@ -79,6 +115,9 @@ DEFAULT_PARAM: dict[str, float | None] = {
     "svc_stall": 1.0,
     "svc_shed": None,
     "backend_down": 1.0,
+    "svc_refresh_corrupt": None,
+    "replica_down": 1.0,
+    "svc_drain": None,
 }
 
 
@@ -175,8 +214,22 @@ class ChaosSchedule:
             return len(self._pending)
 
     def take(self, worker_id: int, seg_id: int) -> list[dict]:
+        return self.take_kinds(worker_id, seg_id, None)
+
+    def take_kinds(
+        self, worker_id: int, seg_id: int, kinds: tuple[str, ...] | None
+    ) -> list[dict]:
+        """Like :meth:`take`, but only consume directives whose kind is in
+        ``kinds`` (None = all). The query service's dispatcher and its
+        ledger follower number their "segments" independently (request
+        sequence vs refresh attempt), so each must only draw — and
+        consume — its own kinds."""
         with self._lock:
-            hit = [d for d in self._pending if d.matches(worker_id, seg_id)]
+            hit = [
+                d for d in self._pending
+                if d.matches(worker_id, seg_id)
+                and (kinds is None or d.kind in kinds)
+            ]
             if hit:
                 taken = set(map(id, hit))
                 self._pending = [

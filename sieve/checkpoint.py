@@ -37,6 +37,16 @@ snapshot open that verifies the checksum but never quarantines, salvages,
 or flushes — a concurrent reader must not race the coordinator's
 atomic-replace or steal its corrupt-file recovery. A read-only ledger
 raises on :meth:`Ledger.record`.
+
+Live-following readers (ISSUE 8) poll :func:`ledger_fingerprint` (mtime
++ size, no read) and re-open when it moves; :attr:`Ledger.checksum`
+identifies the loaded content so an atomic rewrite of identical bytes is
+a no-op swap. Two windows a reader must survive without crashing: the
+file vanishing between ``stat`` and ``read`` (the writing coordinator's
+quarantine ``os.replace``) reads as an *empty snapshot*, same as a
+ledger that never existed; a checksum-less version-1 file loads with
+:attr:`Ledger.unverified` set so the caller can emit a
+``ledger_unverified`` warning instead of trusting it silently.
 """
 
 from __future__ import annotations
@@ -83,6 +93,17 @@ def _fsync_enabled() -> bool:
     return os.environ.get("SIEVE_LEDGER_FSYNC", "1") != "0"
 
 
+def ledger_fingerprint(path: Path | str) -> tuple[int, int] | None:
+    """Cheap change detector for live-following readers: (mtime_ns, size),
+    or None when the file is absent. One stat, no read — pollers compare
+    fingerprints and only re-open (and checksum) when it moves."""
+    try:
+        st = os.stat(path)
+    except FileNotFoundError:
+        return None
+    return (st.st_mtime_ns, st.st_size)
+
+
 def _salvage_entries(text: str) -> dict[int, dict]:
     """Recover complete, sane SegmentResult entries from corrupt ledger
     bytes (truncation keeps every fully-written entry intact)."""
@@ -107,6 +128,11 @@ class Ledger:
         self.salvaged = 0
         self.quarantined: str | None = None
         self.read_only = False
+        # read-only provenance: the loaded payload's content checksum
+        # (computed for v1 files, which carry none — unverified is then
+        # True so callers can emit a ledger_unverified warning)
+        self.checksum: str | None = None
+        self.unverified = False
 
     @classmethod
     def open(cls, config: "SieveConfig") -> "Ledger":
@@ -158,14 +184,24 @@ class Ledger:
         (that is the writing coordinator's recovery to perform — a reader
         racing it could steal the atomic-replace) and nothing is ever
         flushed back. A missing ledger is an empty snapshot, not an error:
-        the service starts cold and fills from backends.
+        the service starts cold and fills from backends. The same goes
+        for a file that vanishes *between* the existence check and the
+        read — that is the coordinator's quarantine ``os.replace`` window,
+        not a reader bug — so the TOCTOU race reads as empty, never as an
+        escaped ``FileNotFoundError``.
         """
         assert config.checkpoint_dir is not None
         path = Path(config.checkpoint_dir) / LEDGER_NAME
         chash = config.config_hash()
         entries: dict[int, dict] = {}
-        if path.exists():
-            data, corrupt = cls._parse(path.read_text())
+        unverified = False
+        checksum: str | None = None
+        try:
+            text = path.read_text() if path.exists() else None
+        except FileNotFoundError:
+            text = None  # quarantined out from under us mid-open
+        if text is not None:
+            data, corrupt = cls._parse(text)
             if data is None:
                 raise LedgerCorrupt(
                     f"ledger at {path} is corrupt ({corrupt}); refusing "
@@ -180,8 +216,14 @@ class Ledger:
                     "the segment counts would describe a different sieve"
                 )
             entries = {int(k): v for k, v in data.get("completed", {}).items()}
+            unverified = "checksum" not in data
+            checksum = data.get("checksum") or _payload_checksum(
+                chash, data.get("completed") or {}
+            )
         ledger = cls(path, chash, entries)
         ledger.read_only = True
+        ledger.unverified = unverified
+        ledger.checksum = checksum
         return ledger
 
     @staticmethod

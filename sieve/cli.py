@@ -187,6 +187,16 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--deadline-s", type=float, default=None,
                    help="default per-request deadline "
                         "(default SIEVE_SVC_DEADLINE_S/30)")
+    p.add_argument("--refresh-s", type=float, default=None,
+                   help="ledger live-follow poll period (default "
+                        "SIEVE_SVC_REFRESH_S/2.0; 0 disables the follower)")
+    p.add_argument("--drain-s", type=float, default=None,
+                   help="graceful-drain budget after SIGTERM/shutdown "
+                        "(default SIEVE_SVC_DRAIN_S/5.0)")
+    p.add_argument("--allow-chaos", action="store_true",
+                   help="accept wire-injected chaos messages (default OFF: "
+                        "a refused injection gets a typed bad_request and "
+                        "a service_chaos_refused event)")
     p.add_argument("--chaos", default=None,
                    help="service fault schedule, e.g. 'svc_stall:any@s3:2.0,"
                         "svc_shed:any@s5,backend_down:any@s7:1.0' (segment "
@@ -225,6 +235,12 @@ def _serve(argv: list[str]) -> int:
         overrides["workers"] = args.service_workers
     if args.deadline_s is not None:
         overrides["default_deadline_s"] = args.deadline_s
+    if args.refresh_s is not None:
+        overrides["refresh_s"] = args.refresh_s
+    if args.drain_s is not None:
+        overrides["drain_s"] = args.drain_s
+    if args.allow_chaos:
+        overrides["wire_chaos"] = True
     settings = ServiceSettings.from_env(**overrides)
 
     file_sink = None
@@ -245,9 +261,20 @@ def _serve(argv: list[str]) -> int:
             "total_primes": service.index.total_primes,
             "segments": len(service.index.segments),
         }), flush=True)
-        import threading
+        import signal
 
-        threading.Event().wait()  # serve until interrupted
+        # SIGTERM = graceful drain (rolling restarts send it): answer
+        # queued work, shed new queries typed, exit 0 within --drain-s.
+        # SIGINT/KeyboardInterrupt stays the fast ctrl-C path.
+        signal.signal(signal.SIGTERM, lambda *_: service.drain())
+        service.drain_event.wait()  # serve until SIGTERM/shutdown
+        drained = service.wait_drained(settings.drain_s)
+        print(json.dumps({
+            "event": "drained",
+            "clean": drained,
+            "stats": {k: service.stats()[k]
+                      for k in ("requests", "draining_replies")},
+        }), flush=True)
     except KeyboardInterrupt:
         pass
     finally:

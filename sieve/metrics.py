@@ -70,6 +70,19 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "service_shed": {"op", "queue_depth"},
     "service_coalesced": {"op", "lo", "hi"},
     "service_degraded": {"entering", "reason"},
+    # replication plane (ISSUE 8): service_refreshed marks each live
+    # snapshot swap (covered_hi is monotonic per process);
+    # service_refresh_failed a skipped refresh (corrupt / mid-quarantine
+    # / regressing read); service_drain the flip to draining;
+    # service_chaos_refused a wire chaos injection denied by the
+    # --allow-chaos gate; ledger_unverified a checksum-less v1 read-only
+    # open (loads, but never silently).
+    "service_refreshed": {"covered_hi", "prev_covered_hi", "segments",
+                          "refreshes"},
+    "service_refresh_failed": {"reason"},
+    "service_drain": {"queued", "inflight"},
+    "service_chaos_refused": {"spec"},
+    "ledger_unverified": {"path"},
 }
 
 

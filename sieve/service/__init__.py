@@ -13,15 +13,30 @@ framing (sieve/rpc.py), failure-first:
   breaker that keeps hot-index queries alive while the cold backend is
   down (degraded health, never a wrong number).
 * :mod:`sieve.service.client` — :class:`ServiceClient`, the blocking
-  client used by the CLI, tests, and tools/service_smoke.py.
+  client used by the CLI, tests, and tools/service_smoke.py, and
+  :class:`ReplicaSet`, the failover client over N replicas (ISSUE 8).
+
+Replication (ISSUE 8): each replica live-follows the shared ledger via
+:class:`~sieve.service.server.LedgerFollower` (atomic snapshot swaps,
+monotonic ``covered_hi``), drains gracefully on SIGTERM/``shutdown``
+(typed ``draining`` sheds, zero dropped in-flight answers), and clients
+spread across replicas with :class:`ReplicaSet` — so a rolling restart
+of the query plane is invisible except as failovers.
 """
 
-from sieve.service.client import ServiceClient, ServiceError
+from sieve.service.client import (
+    CallTimeout,
+    ReplicaSet,
+    ServiceClient,
+    ServiceError,
+)
 from sieve.service.index import QueryCtx, SieveIndex
 from sieve.service.server import (
     BadRequest,
     DeadlineExceeded,
     Degraded,
+    Draining,
+    LedgerFollower,
     Overloaded,
     ServiceSettings,
     SieveService,
@@ -29,10 +44,14 @@ from sieve.service.server import (
 
 __all__ = [
     "BadRequest",
+    "CallTimeout",
     "DeadlineExceeded",
     "Degraded",
+    "Draining",
+    "LedgerFollower",
     "Overloaded",
     "QueryCtx",
+    "ReplicaSet",
     "ServiceClient",
     "ServiceError",
     "ServiceSettings",

@@ -5,16 +5,37 @@ ordered, so a synchronous client never sees an interleaved reply.
 
 Typed errors surface as :class:`ServiceError` with the server's error
 kind (``overloaded`` / ``deadline_exceeded`` / ``degraded`` /
-``bad_request`` / ``internal``) and any partial answer; callers that
-want the raw reply dict (tools/service_smoke.py inspects typed outcomes)
-use :meth:`ServiceClient.query`.
+``draining`` / ``bad_request`` / ``internal``) and any partial answer;
+callers that want the raw reply dict (tools/service_smoke.py inspects
+typed outcomes) use :meth:`ServiceClient.query`.
+
+A ``socket.timeout`` mid-call poisons the connection: the request is
+still in flight server-side, so the *next* recv on that socket would
+read this call's reply as its own — silent desync, wrong numbers. The
+client closes the socket and raises :class:`CallTimeout` instead; every
+later call on the same client fails fast with :class:`ConnectionError`.
+
+:class:`ReplicaSet` (ISSUE 8) wraps N replica addresses behind the same
+ops surface with failover: health-probe-based selection, a per-replica
+circuit (consecutive connection failures open it for a capped-
+exponential cooldown; reuse of a half-open replica re-probes first), and
+a retry policy typed per error kind — connection drops / timeouts /
+``overloaded`` / ``degraded`` / ``draining`` fail over to the next
+replica, while ``bad_request`` and ``deadline_exceeded`` never retry
+(the answer would be the same, and a deadline'd retry doubles the spend
+the caller bounded). Exhausting every replica across all rounds raises
+the last typed error seen, else ``ServiceError("unavailable")`` — the
+set never invents an answer.
 """
 
 from __future__ import annotations
 
 import itertools
+import random
 import socket
-from typing import Any
+import threading
+import time
+from typing import Any, Sequence
 
 from sieve.rpc import parse_addr, recv_msg, send_msg
 
@@ -27,13 +48,24 @@ class ServiceError(RuntimeError):
         self.partial = partial
 
 
+class CallTimeout(ServiceError):
+    """The reply didn't arrive within the socket timeout. The connection
+    is closed (reply stream desynced) — the request may still complete
+    server-side, so the outcome is *unknown*, never assumed failed."""
+
+    def __init__(self, detail: str):
+        super().__init__("timeout", detail)
+
+
 class ServiceClient:
     def __init__(self, addr: str, timeout_s: float = 60.0):
         host, port = parse_addr(addr)
         self._sock = socket.create_connection((host, port), timeout=timeout_s)
         self._ids = itertools.count(1)
+        self._dead = False
 
     def close(self) -> None:
+        self._dead = True
         try:
             self._sock.close()
         except OSError:
@@ -48,9 +80,23 @@ class ServiceClient:
     # --- raw -------------------------------------------------------------
 
     def _call(self, msg: dict) -> dict:
+        if self._dead:
+            raise ConnectionError(
+                "connection closed (earlier timeout desynced the reply "
+                "stream); open a new client"
+            )
         msg.setdefault("id", next(self._ids))
         send_msg(self._sock, msg)
-        reply = recv_msg(self._sock)
+        try:
+            reply = recv_msg(self._sock)
+        except socket.timeout:
+            # the request is still in flight server-side: a later recv on
+            # this socket would read THIS reply as its own — close it
+            self.close()
+            raise CallTimeout(
+                f"no reply within {self._sock.gettimeout()}s; connection "
+                "closed (request outcome unknown)"
+            ) from None
         if reply is None:
             raise ConnectionError("service closed the connection")
         return reply
@@ -98,5 +144,214 @@ class ServiceClient:
     def stats(self) -> dict:
         return self._call({"type": "stats"})["stats"]
 
+    def shutdown(self) -> dict:
+        """Ask the server to drain (the wire twin of SIGTERM)."""
+        return self._call({"type": "shutdown"})
+
     def inject_chaos(self, spec: str) -> dict:
         return self._call({"type": "chaos", "spec": spec})
+
+
+# --- replica failover --------------------------------------------------------
+
+# typed error kinds that justify trying another replica: the condition is
+# local to the replica (its queue, its backend, its lifecycle), so a
+# sibling may well answer. bad_request would fail identically everywhere;
+# deadline_exceeded already spent the caller's budget.
+FAILOVER_KINDS = frozenset({"overloaded", "degraded", "draining"})
+
+
+class _Replica:
+    """One address + its connection and circuit state. ``lock`` guards the
+    send/recv pair (framing = one request in flight per connection)."""
+
+    __slots__ = ("addr", "client", "lock", "fails", "open_until", "probed")
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.client: ServiceClient | None = None
+        self.lock = threading.Lock()
+        self.fails = 0
+        self.open_until = 0.0
+        self.probed = False
+
+
+class ReplicaSet:
+    """Failover client over N replica addresses (see module docstring).
+
+    Thread-safe: the set-level lock covers selection and circuit state;
+    each replica's lock serializes its connection. ``rounds`` full passes
+    over the replica list are attempted, with the PR 6 capped-exponential
+    + jitter backoff between passes, before giving up.
+    """
+
+    def __init__(
+        self,
+        addrs: Sequence[str],
+        timeout_s: float = 60.0,
+        probe_timeout_s: float = 2.0,
+        rounds: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 1.0,
+        circuit_cooldown_s: float = 1.0,
+    ):
+        if not addrs:
+            raise ValueError("ReplicaSet needs at least one address")
+        self._replicas = [_Replica(a) for a in addrs]
+        self.timeout_s = timeout_s
+        self.probe_timeout_s = probe_timeout_s
+        self.rounds = rounds
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.circuit_cooldown_s = circuit_cooldown_s
+        self._lock = threading.Lock()
+        self._rr = 0
+        # observability for tools/tests: how often selection failed over
+        self.failovers = 0
+        self.probes = 0
+
+    def close(self) -> None:
+        for rep in self._replicas:
+            with rep.lock:
+                if rep.client is not None:
+                    rep.client.close()
+                    rep.client = None
+
+    def __enter__(self) -> "ReplicaSet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- selection & circuit ---------------------------------------------
+
+    def _candidates(self) -> list[_Replica]:
+        """Replicas in try-order: round-robin rotation, circuit-closed
+        first; open-but-expired (half-open) after; still-open last — a
+        fully broken set must still attempt *something* each round."""
+        now = time.monotonic()
+        with self._lock:
+            order = (self._replicas[self._rr:] + self._replicas[: self._rr])
+            self._rr = (self._rr + 1) % len(self._replicas)
+        closed = [r for r in order if r.fails == 0]
+        half = [r for r in order if r.fails > 0 and now >= r.open_until]
+        still = [r for r in order if r.fails > 0 and now < r.open_until]
+        return closed + half + still
+
+    def _mark_down(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.fails += 1
+            cooldown = min(
+                self.backoff_cap_s * 8,
+                self.circuit_cooldown_s * (2 ** min(rep.fails - 1, 6)),
+            )
+            rep.open_until = time.monotonic() + cooldown
+            rep.probed = False
+        with rep.lock:
+            if rep.client is not None:
+                rep.client.close()
+                rep.client = None
+
+    def _mark_up(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.fails = 0
+            rep.open_until = 0.0
+
+    def _ensure_client(self, rep: _Replica) -> ServiceClient:
+        """Connect + health-probe (caller holds rep.lock). A replica that
+        was marked down — or never used — must prove itself with a probe
+        before it gets real queries; a draining replica fails the probe
+        so rolling restarts steer new work away without a single typed
+        ``draining`` round-trip wasted."""
+        if rep.client is None:
+            rep.client = ServiceClient(rep.addr, timeout_s=self.timeout_s)
+            rep.probed = False
+        if not rep.probed:
+            rep.client._sock.settimeout(self.probe_timeout_s)
+            try:
+                health = rep.client.health()
+            finally:
+                rep.client._sock.settimeout(self.timeout_s)
+            with self._lock:
+                self.probes += 1
+            if health.get("draining"):
+                raise ServiceError("draining", f"{rep.addr} is draining")
+            rep.probed = True
+        return rep.client
+
+    # --- calls ------------------------------------------------------------
+
+    def query(self, op: str, deadline_s: float | None = None,
+              **params: Any) -> dict:
+        """One query with failover; returns the raw reply dict. Raises
+        ConnectionError-shaped failures only as a final
+        ``ServiceError("unavailable")`` after every replica and round is
+        exhausted; a non-failover typed error returns immediately."""
+        msg: dict[str, Any] = {"type": "query", "op": op, **params}
+        if deadline_s is not None:
+            msg["deadline_s"] = deadline_s
+        last_typed: dict | None = None
+        last_err: Exception | None = None
+        for attempt in range(1, self.rounds + 1):
+            for i, rep in enumerate(self._candidates()):
+                if i > 0:
+                    with self._lock:
+                        self.failovers += 1
+                try:
+                    with rep.lock:
+                        client = self._ensure_client(rep)
+                        # fresh copy per attempt: ids are per-connection,
+                        # and a retried dict must not pin a stale one
+                        reply = client._call(dict(msg))
+                except (ConnectionError, OSError, CallTimeout) as e:
+                    self._mark_down(rep)
+                    last_err = e
+                    continue
+                except ServiceError as e:  # probe said draining
+                    self._mark_down(rep)
+                    last_typed = {"ok": False, "error": e.kind,
+                                  "detail": e.detail, "op": op}
+                    continue
+                self._mark_up(rep)
+                if reply.get("ok") or reply.get("error") not in FAILOVER_KINDS:
+                    return reply
+                last_typed = reply  # overloaded/degraded/draining: next
+            if attempt < self.rounds:
+                # PR 6 backoff shape: capped exponential, full jitter
+                delay = min(self.backoff_cap_s,
+                            self.backoff_base_s * (2 ** (attempt - 1)))
+                time.sleep(delay * (0.5 + random.random()))
+        if last_typed is not None:
+            return last_typed
+        raise ServiceError(
+            "unavailable",
+            f"no replica answered after {self.rounds} rounds over "
+            f"{len(self._replicas)} replicas (last: {last_err!r})",
+        )
+
+    def _value(self, reply: dict):
+        if reply.get("ok"):
+            return reply["value"]
+        raise ServiceError(
+            reply.get("error", "internal"),
+            reply.get("detail", ""),
+            reply.get("partial"),
+        )
+
+    # --- ops (same surface as ServiceClient) ------------------------------
+
+    def pi(self, x: int, deadline_s: float | None = None) -> int:
+        return self._value(self.query("pi", deadline_s, x=x))
+
+    def count(self, lo: int, hi: int, kind: str = "primes",
+              deadline_s: float | None = None) -> int:
+        return self._value(
+            self.query("count", deadline_s, lo=lo, hi=hi, kind=kind)
+        )
+
+    def nth_prime(self, k: int, deadline_s: float | None = None) -> int:
+        return self._value(self.query("nth_prime", deadline_s, k=k))
+
+    def primes(self, lo: int, hi: int,
+               deadline_s: float | None = None) -> list[int]:
+        return self._value(self.query("primes", deadline_s, lo=lo, hi=hi))

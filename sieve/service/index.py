@@ -107,6 +107,7 @@ class SieveIndex:
         packing: str,
         entries: dict[int, SegmentResult] | Sequence[SegmentResult],
         lru_segments: int = 32,
+        lru: BitsetLRU | None = None,
     ):
         self.packing = packing
         self.layout = get_layout(packing)
@@ -132,7 +133,11 @@ class SieveIndex:
         self.bounds: list[int] = [r.lo for r in self.segments] + (
             [self.covered_hi] if self.segments else []
         )
-        self.lru = BitsetLRU(lru_segments)
+        # live-follow (ISSUE 8): a refreshed index is handed the previous
+        # snapshot's LRU so hot queries stay hot across swaps — flags
+        # content depends only on (packing, lo, hi), never on ledger
+        # entries, so cached chunks are exact under any snapshot
+        self.lru = lru if lru is not None else BitsetLRU(lru_segments)
         self._stat_lock = threading.Lock()
         self.lru_hits = 0
         self.materialized = 0

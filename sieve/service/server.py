@@ -22,6 +22,25 @@ One :class:`SieveService` owns four tiers:
   health. It never trades exactness for availability — a reply is
   exact or it is a typed error.
 
+Replication (ISSUE 8) adds two lifecycle behaviors on top:
+
+* **live follow** — a :class:`LedgerFollower` polls the ledger file
+  (fingerprint stat every ``SIEVE_SVC_REFRESH_S``) and, when the
+  writing coordinator has extended it, re-opens read-only and swaps in
+  a fresh :class:`SieveIndex` *by one reference assignment* — in-flight
+  queries finish on the snapshot they started on, the new index
+  inherits the old BitsetLRU so hot queries stay hot, and
+  ``covered_hi`` is monotonic per process (a regressing or corrupt or
+  mid-quarantine read is a *skipped* refresh with a
+  ``service_refresh_failed`` event, never a crash and never a shrink).
+* **graceful drain** — SIGTERM or a ``shutdown`` control message flips
+  the server to draining: the listener closes, queued work is answered
+  to completion, new queries are shed as typed ``draining``, and
+  :meth:`SieveService.wait_drained` releases the host process once the
+  last in-flight reply is out (the CLI exits 0 after at most
+  ``SIEVE_SVC_DRAIN_S``). A rolling restart loses zero in-flight
+  answers.
+
 Wire protocol (sieve/rpc.py framing; one JSON object per message):
 
     {"type": "query", "id": i, "op": "pi", "x": 10**9, "deadline_s": 2}
@@ -49,8 +68,12 @@ import numpy as np
 
 from sieve import trace
 from sieve.backends import make_worker
-from sieve.chaos import SERVICE_KINDS, ChaosSchedule, parse_chaos
-from sieve.checkpoint import Ledger
+from sieve.chaos import SERVICE_REQUEST_KINDS, ChaosSchedule, parse_chaos
+from sieve.checkpoint import (
+    Ledger,
+    LedgerMismatch,
+    ledger_fingerprint,
+)
 from sieve.enumerate import MAX_HI, primes_in_range
 from sieve.metrics import MetricsLogger, registry
 from sieve.rpc import parse_addr, recv_msg, send_msg
@@ -85,11 +108,16 @@ class BadRequest(Exception):
     pass
 
 
+class Draining(Exception):
+    """Server is draining (SIGTERM / shutdown): new queries are shed."""
+
+
 _ERROR_KIND = {
     Overloaded: "overloaded",
     DeadlineExceeded: "deadline_exceeded",
     Degraded: "degraded",
     BadRequest: "bad_request",
+    Draining: "draining",
 }
 
 
@@ -115,6 +143,14 @@ class ServiceSettings:
     max_pair_span: int = 10**8
     breaker_fails: int = 3
     breaker_cooldown_s: float = 5.0
+    # live follow: ledger poll period (0 disables the follower entirely)
+    refresh_s: float = 2.0
+    # graceful drain: hard exit budget once draining starts
+    drain_s: float = 5.0
+    # wire-injectable chaos (the "chaos" message): default OFF — any
+    # client could otherwise fault-inject a production server. The CLI
+    # spells this --allow-chaos; --chaos-config schedules still apply.
+    wire_chaos: bool = False
     # test/chaos knob: extra latency per cold compute, to simulate a
     # saturated backend deterministically (coalescing/shed scenarios)
     cold_delay_s: float = 0.0
@@ -140,6 +176,10 @@ class ServiceSettings:
             breaker_cooldown_s=_env_float(
                 "SIEVE_SVC_BREAKER_COOLDOWN_S", cls.breaker_cooldown_s
             ),
+            refresh_s=_env_float("SIEVE_SVC_REFRESH_S", cls.refresh_s),
+            drain_s=_env_float("SIEVE_SVC_DRAIN_S", cls.drain_s),
+            wire_chaos=os.environ.get("SIEVE_SVC_WIRE_CHAOS", "0")
+            not in ("0", "", "false"),
             cold_delay_s=_env_float("SIEVE_SVC_COLD_DELAY_S", cls.cold_delay_s),
         )
         return dataclasses.replace(s, **overrides)
@@ -252,6 +292,133 @@ class _Flight:
         self.error: Exception | None = None
 
 
+class LedgerFollower:
+    """Live-follow the ledger a concurrent coordinator is extending.
+
+    A daemon thread stats the ledger file every ``refresh_s``; when the
+    fingerprint (mtime + size) moves it re-opens read-only, verifies the
+    checksum, builds a fresh :class:`SieveIndex` that *inherits the old
+    BitsetLRU*, and swaps it in with one reference assignment — readers
+    that captured the previous index finish on it untouched. Invariants:
+
+    * ``covered_hi`` is monotonic per process: a snapshot that would
+      shrink coverage (the coordinator's quarantine window, a rewritten
+      or foreign ledger) is a skipped refresh, never a swap.
+    * a corrupt / mid-quarantine / vanished read is a skipped refresh
+      with a ``service_refresh_failed`` event — never a crash; the stale
+      fingerprint is dropped so the very next poll retries.
+    * each swap emits ``service_refreshed`` + the ``cluster.covered_hi``
+      gauge and a ``service.refresh`` trace span.
+
+    ``poll_once`` is the whole state machine and is callable directly
+    (tests drive it synchronously); the thread only adds the timer.
+    """
+
+    def __init__(self, service: "SieveService", refresh_s: float):
+        self.service = service
+        self.refresh_s = refresh_s
+        self._path = service.ledger_path
+        assert self._path is not None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._poll_lock = threading.Lock()
+        self._last_fp = ledger_fingerprint(self._path)
+        self._last_checksum = (
+            service.ledger.checksum if service.ledger is not None else None
+        )
+        self.attempts = 0  # refresh *attempts* — the svc_refresh_corrupt key
+
+    def start(self) -> "LedgerFollower":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="svc-follower"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.refresh_s):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — the follower never dies
+                self._failed(trace.now_s(), f"unexpected: {e!r}")
+
+    def poll_once(self) -> str:
+        """One poll step; returns "unchanged" / "swapped" / "failed"."""
+        with self._poll_lock:
+            return self._poll_locked()
+
+    def _poll_locked(self) -> str:
+        svc = self.service
+        fp = ledger_fingerprint(self._path)
+        if fp == self._last_fp:
+            return "unchanged"
+        self.attempts += 1
+        t0 = trace.now_s()
+        if svc.chaos.take_kinds(0, self.attempts, ("svc_refresh_corrupt",)):
+            self._failed(t0, "chaos svc_refresh_corrupt injected")
+            return "failed"
+        try:
+            led = svc._open_snapshot()
+        except (LedgerMismatch, OSError, ValueError) as e:
+            self._failed(t0, f"{type(e).__name__}: {e}")
+            return "failed"
+        if led.checksum == self._last_checksum:
+            self._last_fp = fp  # atomic rewrite of identical content
+            return "unchanged"
+        old = svc.index
+        new = SieveIndex(
+            svc.config.packing, led.completed(),
+            svc.settings.lru_segments, lru=old.lru,
+        )
+        if new.covered_hi < old.covered_hi:
+            self._failed(
+                t0,
+                f"covered_hi would regress {old.covered_hi} -> "
+                f"{new.covered_hi} (mid-quarantine or rewritten ledger); "
+                "keeping the previous snapshot",
+            )
+            return "failed"
+        # THE swap: one reference assignment. In-flight queries hold the
+        # old index (captured at admission) and finish on it; new
+        # requests see the new one. Never mutate an index in place.
+        svc.index = new
+        svc.ledger = led
+        svc._snapshot_ts = trace.now_s()
+        svc._refreshes += 1
+        self._last_fp = fp
+        self._last_checksum = led.checksum
+        registry().gauge("cluster.covered_hi").set(float(new.covered_hi))
+        svc.metrics.event(
+            "service_refreshed",
+            covered_hi=new.covered_hi,
+            prev_covered_hi=old.covered_hi,
+            segments=len(new.segments),
+            refreshes=svc._refreshes,
+        )
+        trace.add_span(
+            "service.refresh", t0, trace.now_s() - t0,
+            outcome="swapped", covered_hi=new.covered_hi,
+            prev_covered_hi=old.covered_hi,
+        )
+        return "swapped"
+
+    def _failed(self, t0: float, reason: str) -> None:
+        svc = self.service
+        svc._refresh_failed += 1
+        self._last_fp = None  # retry on the very next poll
+        svc.metrics.event("service_refresh_failed", reason=reason)
+        registry().counter("service.refresh_failed").inc()
+        trace.add_span(
+            "service.refresh", t0, trace.now_s() - t0,
+            outcome="failed", reason=reason,
+        )
+
+
 _STATS = (
     "requests",
     "index_hits",
@@ -261,6 +428,7 @@ _STATS = (
     "shed",
     "deadline_exceeded",
     "degraded_replies",
+    "draining_replies",
     "bad_requests",
     "internal_errors",
 )
@@ -278,15 +446,22 @@ class SieveService:
         self.config = config
         self.settings = settings or ServiceSettings.from_env()
         self._addr_req = addr or "127.0.0.1:0"
+        self.metrics = MetricsLogger(config)
         entries = {}
         self.ledger = None
         if config.checkpoint_dir:
-            self.ledger = Ledger.open_readonly(config)
+            self.ledger = self._open_snapshot()
             entries = self.ledger.completed()
         self.index = SieveIndex(
             config.packing, entries, self.settings.lru_segments
         )
-        self.metrics = MetricsLogger(config)
+        registry().gauge("cluster.covered_hi").set(
+            float(self.index.covered_hi)
+        )
+        self._snapshot_ts = trace.now_s()
+        self._refreshes = 0
+        self._refresh_failed = 0
+        self.follower: LedgerFollower | None = None
         self.cold = ColdBackend(config, self.settings, self._on_degraded)
         self.chaos = ChaosSchedule(config.chaos_directives())
         self._cold_lock = threading.Lock()
@@ -302,20 +477,53 @@ class SieveService:
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
         self._listener: socket.socket | None = None
+        self._bound_addr: str | None = None
         self._closing = False
+        # graceful drain (ISSUE 8): _inflight_n counts admitted-but-not-
+        # replied queries; drain_event fires when draining starts, and
+        # _drained once the last in-flight reply is out
+        self._draining = False
+        self._inflight_n = 0
+        self._inflight_lock = threading.Lock()
+        self.drain_event = threading.Event()
+        self._drained = threading.Event()
+        # replica_down chaos: while live, every connection is dropped
+        # without a reply — a dead replica from the client's side
+        self._replica_down_until = 0.0
 
     # --- lifecycle -------------------------------------------------------
 
     @property
     def addr(self) -> str:
-        assert self._listener is not None, "service not started"
-        host, port = self._listener.getsockname()[:2]
-        return f"{host}:{port}"
+        # cached at bind time: drain() closes the listener but the bound
+        # address must stay queryable while connections finish
+        assert self._bound_addr is not None, "service not started"
+        return self._bound_addr
+
+    @property
+    def ledger_path(self):
+        if not self.config.checkpoint_dir:
+            return None
+        from pathlib import Path
+
+        from sieve.checkpoint import LEDGER_NAME
+
+        return Path(self.config.checkpoint_dir) / LEDGER_NAME
+
+    def _open_snapshot(self) -> Ledger:
+        """Read-only ledger open + the v1-compat warning event: a
+        checksum-less version-1 file loads, but never silently."""
+        led = Ledger.open_readonly(self.config)
+        if led.unverified:
+            self.metrics.event("ledger_unverified", path=str(led.path))
+        return led
 
     def start(self) -> "SieveService":
         host, port = parse_addr(self._addr_req)
         self._listener = socket.create_server((host, port))
         self._listener.listen(64)
+        bhost, bport = self._listener.getsockname()[:2]
+        self._bound_addr = f"{bhost}:{bport}"
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="svc-accept")
         t.start()
@@ -325,10 +533,54 @@ class SieveService:
                                  name=f"svc-worker-{i}")
             w.start()
             self._threads.append(w)
+        if self.config.checkpoint_dir and self.settings.refresh_s > 0:
+            self.follower = LedgerFollower(
+                self, self.settings.refresh_s
+            ).start()
         return self
+
+    def drain(self) -> None:
+        """Flip to draining: stop accepting, answer queued work, shed new
+        queries as typed ``draining``. Idempotent; SIGTERM, the wire
+        ``shutdown`` message, and the ``svc_drain`` chaos kind all land
+        here."""
+        if self._draining:
+            return
+        self._draining = True
+        if self._listener is not None:
+            # shutdown before close: close() alone leaves the socket alive
+            # while the accept thread is blocked in accept() (it holds a
+            # kernel reference), letting one more connection slip in;
+            # shutdown() wakes the accept and refuses connects immediately
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        self.metrics.event("service_drain", queued=self._queue.qsize(),
+                           inflight=self._inflight_n)
+        registry().gauge("service.draining").set(1.0)
+        self.drain_event.set()
+        self._maybe_drained()
+
+    def wait_drained(self, timeout: float | None = None) -> bool:
+        """Block until every admitted query has been answered (True), or
+        the timeout expired with work still in flight (False)."""
+        return self._drained.wait(timeout)
+
+    def _maybe_drained(self) -> None:
+        with self._inflight_lock:
+            done = self._draining and self._inflight_n == 0
+        if done:
+            self._drained.set()
 
     def stop(self) -> None:
         self._closing = True
+        if self.follower is not None:
+            self.follower.stop()
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -349,6 +601,7 @@ class SieveService:
         for t in self._threads:
             t.join(timeout=5)
         self.cold.close()
+        self._drained.set()
 
     def __enter__(self) -> "SieveService":
         return self.start()
@@ -369,6 +622,13 @@ class SieveService:
         out.update(self.index.stats())
         out["queue_depth"] = self._queue.qsize()
         out["degraded"] = self.cold.degraded
+        out["refreshes"] = self._refreshes
+        out["refresh_failed"] = self._refresh_failed
+        out["refresh_attempts"] = (
+            self.follower.attempts if self.follower is not None else 0
+        )
+        out["snapshot_age_s"] = round(trace.now_s() - self._snapshot_ts, 3)
+        out["draining"] = self._draining
         return out
 
     def _on_degraded(self, entering: bool, reason: str) -> None:
@@ -408,7 +668,10 @@ class SieveService:
                     return
                 if msg is None:
                     return
-                self._dispatch(conn, send_lock, msg)
+                if trace.now_s() < self._replica_down_until:
+                    return  # replica_down chaos: drop, no reply
+                if self._dispatch(conn, send_lock, msg) == "drop":
+                    return
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
@@ -425,61 +688,110 @@ class SieveService:
         except OSError:
             pass  # client went away; its outcome is already counted
 
-    def _dispatch(self, conn, send_lock, msg: dict) -> None:
+    def _dispatch(self, conn, send_lock, msg: dict) -> str | None:
         mtype = msg.get("type")
         rid = msg.get("id")
+        idx = self.index  # one snapshot per message, even for health
         if mtype == "health":
             # answered inline by the reader: health must stay observable
             # under full-queue shed pressure and a dead backend alike
             self._reply(conn, send_lock, {
                 "type": "health", "id": rid, "ok": True,
                 "status": "degraded" if self.cold.degraded else "ok",
-                "covered_hi": self.index.covered_hi,
-                "total_primes": self.index.total_primes,
+                "covered_hi": idx.covered_hi,
+                "total_primes": idx.total_primes,
                 "queue_depth": self._queue.qsize(),
+                "snapshot_age_s": round(
+                    trace.now_s() - self._snapshot_ts, 3
+                ),
+                "refreshes": self._refreshes,
+                "draining": self._draining,
             })
-            return
+            return None
         if mtype == "stats":
             self._reply(conn, send_lock,
                         {"type": "stats", "id": rid, "ok": True,
                          "stats": self.stats()})
-            return
+            return None
+        if mtype == "shutdown":
+            # rolling-restart control message: same path as SIGTERM
+            self._reply(conn, send_lock,
+                        {"type": "reply", "id": rid, "ok": True,
+                         "draining": True})
+            self.drain()
+            return None
         if mtype == "chaos":
+            if not self.settings.wire_chaos:
+                # refusal is typed AND evented: a production server must
+                # record who tried to fault-inject it over the wire
+                self.metrics.event("service_chaos_refused",
+                                   spec=str(msg.get("spec", "")))
+                self._reply(conn, send_lock, {
+                    "type": "reply", "id": rid, "ok": False,
+                    "error": "bad_request",
+                    "detail": "wire chaos injection is disabled on this "
+                              "server (start it with --allow-chaos)",
+                })
+                return None
             try:
                 n = self.inject_chaos(str(msg.get("spec", "")))
             except ValueError as e:
                 self._reply(conn, send_lock,
                             {"type": "reply", "id": rid, "ok": False,
                              "error": "bad_request", "detail": str(e)})
-                return
+                return None
             self._reply(conn, send_lock,
                         {"type": "reply", "id": rid, "ok": True,
                          "injected": n})
-            return
+            return None
         if mtype != "query":
             self._reply(conn, send_lock,
                         {"type": "reply", "id": rid, "ok": False,
                          "error": "bad_request",
                          "detail": f"unknown message type {mtype!r}"})
-            return
+            return None
         with self._seq_lock:
             self._seq += 1
             seq = self._seq
-        directives = [
-            d for d in self.chaos.take(0, seq) if d["kind"] in SERVICE_KINDS
-        ]
+        directives = self.chaos.take_kinds(0, seq, SERVICE_REQUEST_KINDS)
         op = str(msg.get("op", ""))
+        for d in directives:
+            if d["kind"] == "replica_down":
+                self._replica_down_until = max(
+                    self._replica_down_until,
+                    trace.now_s() + float(d["param"] or 0.0),
+                )
+                return "drop"  # dead replica: no reply, connection cut
+            if d["kind"] == "svc_drain":
+                self.drain()
         if any(d["kind"] == "svc_shed" for d in directives):
             self._shed(conn, send_lock, rid, op, forced=True)
-            return
+            return None
+        if self._draining:
+            self._bump("draining_replies")
+            self.metrics.event("service_shed", quietable=True, op=op,
+                               queue_depth=self._queue.qsize(),
+                               reason="draining")
+            self._reply(conn, send_lock, {
+                "type": "reply", "id": rid, "ok": False, "op": op,
+                "error": "draining",
+                "detail": "server is draining (rolling restart); retry "
+                          "on another replica",
+            })
+            return None
         item = (msg, rid if rid is not None else seq, trace.now_s(),
-                directives, conn, send_lock)
+                directives, idx, conn, send_lock)
+        with self._inflight_lock:
+            self._inflight_n += 1
         try:
             self._queue.put_nowait(item)
         except queue.Full:
+            with self._inflight_lock:
+                self._inflight_n -= 1
             self._shed(conn, send_lock, rid, op, forced=False)
-            return
+            return None
         registry().gauge("service.queue_depth").set(self._queue.qsize())
+        return None
 
     def _shed(self, conn, send_lock, rid, op: str, forced: bool) -> None:
         depth = self._queue.qsize()
@@ -508,7 +820,10 @@ class SieveService:
             except Exception:
                 pass  # _handle replies "internal" itself; never die
 
-    def _handle(self, msg, rid, enq_t, directives, conn, send_lock) -> None:
+    def _handle(self, msg, rid, enq_t, directives, idx,
+                conn, send_lock) -> None:
+        # ``idx`` is the snapshot captured at admission: the whole request
+        # runs on it even if the follower swaps self.index mid-flight
         op = str(msg.get("op", ""))
         t_pop = trace.now_s()
         trace.add_span("query.queue_wait", enq_t, t_pop - enq_t, op=op)
@@ -533,7 +848,7 @@ class SieveService:
                     self.cold.force_down(float(d["param"] or 0.0),
                                          "chaos backend_down")
             check()
-            reply["value"] = self._execute(op, msg, ctx, deadline)
+            reply["value"] = self._execute(op, msg, ctx, deadline, idx)
         except tuple(_ERROR_KIND) as e:
             outcome = _ERROR_KIND[type(e)]
             reply = {
@@ -570,7 +885,13 @@ class SieveService:
             "service_request", quietable=True, op=op, outcome=outcome,
             source=source, ms=reply["elapsed_ms"],
         )
-        self._reply(conn, send_lock, reply)
+        try:
+            self._reply(conn, send_lock, reply)
+        finally:
+            # drain accounting: this admitted query is now answered
+            with self._inflight_lock:
+                self._inflight_n -= 1
+            self._maybe_drained()
 
     @staticmethod
     def _partial(op: str, e: Exception) -> dict | None:
@@ -585,31 +906,33 @@ class SieveService:
 
     # --- ops -------------------------------------------------------------
 
-    def _execute(self, op: str, msg: dict, ctx: QueryCtx, deadline: float):
+    def _execute(self, op: str, msg: dict, ctx: QueryCtx, deadline: float,
+                 idx: SieveIndex):
         if op == "pi":
             x = _req_int(msg, "x")
             if x < 0 or x + 1 > MAX_HI:
                 raise BadRequest(f"pi({x}): x must be in [0, {MAX_HI})")
-            return self._count_upto(x + 1, ctx, deadline)
+            return self._count_upto(x + 1, ctx, deadline, idx)
         if op == "count":
             lo, hi = _req_int(msg, "lo"), _req_int(msg, "hi")
             kind = str(msg.get("kind", "primes"))
-            return self._count(lo, hi, kind, ctx, deadline)
+            return self._count(lo, hi, kind, ctx, deadline, idx)
         if op == "nth_prime":
-            return self._nth_prime(_req_int(msg, "k"), ctx, deadline)
+            return self._nth_prime(_req_int(msg, "k"), ctx, deadline, idx)
         if op == "primes":
             lo, hi = _req_int(msg, "lo"), _req_int(msg, "hi")
-            return self._primes(lo, hi, ctx, deadline)
+            return self._primes(lo, hi, ctx, deadline, idx)
         raise BadRequest(
             f"unknown op {op!r} (one of pi, count, nth_prime, primes)"
         )
 
-    def _count_upto(self, v: int, ctx: QueryCtx, deadline: float) -> int:
+    def _count_upto(self, v: int, ctx: QueryCtx, deadline: float,
+                    idx: SieveIndex) -> int:
         """Primes in [2, v): index prefix + cold chunks past covered_hi."""
         if v <= 2:
             return 0
-        covered = min(v, self.index.covered_hi)
-        total = self.index.count_upto(covered, ctx)
+        covered = min(v, idx.covered_hi)
+        total = idx.count_upto(covered, ctx)
         a = covered
         while a < v:
             ctx.tick()
@@ -621,14 +944,14 @@ class SieveService:
         return total
 
     def _count(self, lo: int, hi: int, kind: str,
-               ctx: QueryCtx, deadline: float) -> int:
+               ctx: QueryCtx, deadline: float, idx: SieveIndex) -> int:
         if hi > MAX_HI:
             raise BadRequest(f"count: hi={hi} exceeds {MAX_HI}")
         if hi < lo:
             raise BadRequest(f"count: hi={hi} < lo={lo}")
         if kind == "primes":
-            c_lo = self._count_upto(lo, ctx, deadline)
-            return self._count_upto(hi, ctx, deadline) - c_lo
+            c_lo = self._count_upto(lo, ctx, deadline, idx)
+            return self._count_upto(hi, ctx, deadline, idx) - c_lo
         if kind in ("twins", "cousins"):
             gap = 2 if kind == "twins" else 4
             if hi - lo > self.settings.max_pair_span:
@@ -636,23 +959,25 @@ class SieveService:
                     f"count kind={kind}: span {hi - lo} exceeds "
                     f"{self.settings.max_pair_span} (pair counts enumerate)"
                 )
-            a = self._collect_primes(lo, hi, ctx, deadline, cap=None)
+            a = self._collect_primes(lo, hi, ctx, deadline, cap=None,
+                                     idx=idx)
             return _pairs(a, gap)
         raise BadRequest(
             f"count: unknown kind {kind!r} (primes, twins, cousins)"
         )
 
-    def _nth_prime(self, k: int, ctx: QueryCtx, deadline: float) -> int:
+    def _nth_prime(self, k: int, ctx: QueryCtx, deadline: float,
+                   idx: SieveIndex) -> int:
         if k < 1:
             raise BadRequest(f"nth_prime({k}): k must be >= 1")
-        if k <= self.index.total_primes:
-            return self.index.nth(k, ctx)
+        if k <= idx.total_primes:
+            return idx.nth(k, ctx)
         # extend past the index: cold-count the fixed grid until the
         # containing chunk, then materialize just that chunk locally
-        seen = self.index.total_primes
-        ctx.index = bool(self.index.segments)
+        seen = idx.total_primes
+        ctx.index = bool(idx.segments)
         ctx.count_so_far = max(ctx.count_so_far, seen)
-        a = self.index.covered_hi
+        a = idx.covered_hi
         while True:
             ctx.tick()
             if a >= MAX_HI:
@@ -663,35 +988,37 @@ class SieveService:
             b = min(_grid_next(a, self.settings.cold_chunk), MAX_HI)
             c = self._cold_count(a, b, ctx, deadline)
             if seen + c >= k:
-                return self._nth_in_window(a, b, k - seen, ctx)
+                return self._nth_in_window(a, b, k - seen, ctx, idx)
             seen += c
             a = b
             ctx.answered_hi = max(ctx.answered_hi, a)
             ctx.count_so_far = max(ctx.count_so_far, seen)
 
-    def _nth_in_window(self, lo: int, hi: int, r: int, ctx: QueryCtx) -> int:
+    def _nth_in_window(self, lo: int, hi: int, r: int, ctx: QueryCtx,
+                       idx: SieveIndex) -> int:
         """r-th prime (1-indexed) inside [lo, hi) — r is known to exist."""
-        layout = self.index.layout
+        layout = idx.layout
         extras = [p for p in layout.extra_primes if lo <= p < hi]
         if r <= len(extras):
             return extras[r - 1]
         r -= len(extras)
-        flags = self.index.get_flags(lo, hi, ctx)
+        flags = idx.get_flags(lo, hi, ctx)
         pos = np.nonzero(flags)[0][r - 1]
         return int(layout.values_np(lo, np.array([pos]))[0])
 
     def _primes(self, lo: int, hi: int, ctx: QueryCtx,
-                deadline: float) -> list[int]:
+                deadline: float, idx: SieveIndex) -> list[int]:
         if hi > MAX_HI:
             raise BadRequest(f"primes: hi={hi} exceeds {MAX_HI}")
         if hi < lo:
             raise BadRequest(f"primes: hi={hi} < lo={lo}")
         a = self._collect_primes(lo, hi, ctx, deadline,
-                                 cap=self.settings.max_primes)
+                                 cap=self.settings.max_primes, idx=idx)
         return [int(p) for p in a]
 
     def _collect_primes(self, lo: int, hi: int, ctx: QueryCtx,
-                        deadline: float, cap: int | None) -> np.ndarray:
+                        deadline: float, cap: int | None,
+                        idx: SieveIndex) -> np.ndarray:
         """Materialize primes in [lo, hi) through the enumerate seam,
         feeding hot slices from the index LRU (``flags_fn``) and marking
         the request cold when a slice falls past the covered range."""
@@ -702,7 +1029,7 @@ class SieveService:
 
         def flags_fn(slo: int, shi: int):
             last_slice[0] = shi
-            f = self.index.flags_for_slice(slo, shi, ctx)
+            f = idx.flags_for_slice(slo, shi, ctx)
             if f is None:
                 ctx.cold = True
                 self._bump("cold_computes")
@@ -712,7 +1039,7 @@ class SieveService:
         count = 0
         try:
             gen = primes_in_range(self.config.packing, lo, hi,
-                                  bounds=self.index.bounds, flags_fn=flags_fn)
+                                  bounds=idx.bounds, flags_fn=flags_fn)
         except ValueError as e:
             raise BadRequest(str(e)) from None
         for arr in gen:

@@ -1,0 +1,430 @@
+"""The replicated service plane: live follow, failover, drain (ISSUE 8).
+
+Covers: LedgerFollower snapshot swaps (BitsetLRU inheritance, monotonic
+covered_hi, identical-rewrite no-ops, corrupt / vanished ledgers as
+skipped refreshes with events, svc_refresh_corrupt chaos); graceful
+drain (typed ``draining`` sheds, in-flight answers kept, wire
+``shutdown``, svc_drain chaos, wait_drained); replica_down chaos at the
+connection level; ReplicaSet failover policy (dead replica, draining
+replica, bad_request never retried, all-dead => typed unavailable); the
+CallTimeout desync regression; the --allow-chaos wire gate; and the new
+health freshness fields.
+"""
+
+import json
+import socket
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sieve import metrics
+from sieve.checkpoint import LEDGER_NAME, Ledger
+from sieve.config import SieveConfig
+from sieve.coordinator import run_local
+from sieve.metrics import MemorySink, validate_record
+from sieve.seed import seed_primes
+from sieve.service import (
+    CallTimeout,
+    LedgerFollower,
+    ReplicaSet,
+    ServiceClient,
+    ServiceError,
+    ServiceSettings,
+    SieveService,
+)
+
+N = 50_000
+P = seed_primes(200_000)
+
+
+def o_pi(x):
+    return int(np.searchsorted(P, x, side="right"))
+
+
+@pytest.fixture
+def memsink():
+    sink = MemorySink()
+    metrics.add_sink(sink)
+    yield sink
+    metrics.remove_sink(sink)
+
+
+@pytest.fixture(scope="module")
+def src_dir(tmp_path_factory):
+    """A fully-sieved source dir; tests copy segments out of it into
+    per-test serving dirs a "writer" then extends."""
+    path = tmp_path_factory.mktemp("failover_src")
+    run_local(_cfg(str(path)))
+    return path
+
+
+def _cfg(checkpoint_dir: str, **kw) -> SieveConfig:
+    base = dict(
+        n=N, backend="cpu-numpy", packing="wheel30", n_segments=4,
+        quiet=True, checkpoint_dir=checkpoint_dir,
+    )
+    base.update(kw)
+    return SieveConfig(**base)
+
+
+def _settings(**kw) -> ServiceSettings:
+    base = dict(
+        workers=2, queue_limit=16, default_deadline_s=10.0,
+        cold_chunk=1 << 16, breaker_cooldown_s=0.4,
+        refresh_s=0.0,  # follower driven by hand via poll_once
+    )
+    base.update(kw)
+    return ServiceSettings(**base)
+
+
+def _seed_serving(src_dir, dest: Path, n_segments: int) -> Ledger:
+    """Writer's ledger on ``dest`` holding the first n_segments of src."""
+    segs = sorted(
+        Ledger.open_readonly(_cfg(str(src_dir))).completed().values(),
+        key=lambda r: r.lo,
+    )
+    wled = Ledger.open(_cfg(str(dest)))
+    for r in segs[:n_segments]:
+        wled.record(r)
+    return wled
+
+
+def _remaining(src_dir, n_segments: int):
+    segs = sorted(
+        Ledger.open_readonly(_cfg(str(src_dir))).completed().values(),
+        key=lambda r: r.lo,
+    )
+    return segs[n_segments:]
+
+
+# --- live follow -------------------------------------------------------------
+
+
+def test_follower_swaps_and_inherits_lru(src_dir, tmp_path, memsink):
+    wled = _seed_serving(src_dir, tmp_path, 2)
+    with SieveService(_cfg(str(tmp_path)), _settings()) as svc:
+        fol = LedgerFollower(svc, refresh_s=1.0)  # no thread: poll by hand
+        old = svc.index
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            assert cli.pi(20_000) == o_pi(20_000)  # warms the LRU
+            assert fol.poll_once() == "unchanged"
+            for r in _remaining(src_dir, 2):
+                wled.record(r)
+            assert fol.poll_once() == "swapped"
+            new = svc.index
+            assert new is not old
+            assert new.lru is old.lru  # hot queries stay hot across swaps
+            assert new.covered_hi > old.covered_hi
+            assert svc._refreshes == 1
+            # the freshly covered range answers from the index, exact
+            assert cli.pi(N - 1) == o_pi(N - 1)
+            h = cli.health()
+            assert h["covered_hi"] == new.covered_hi
+            assert h["refreshes"] == 1
+    ev = [x for x in memsink.records if x["event"] == "service_refreshed"]
+    assert len(ev) == 1
+    assert ev[0]["covered_hi"] > ev[0]["prev_covered_hi"]
+    validate_record(ev[0])
+
+
+def test_follower_identical_rewrite_is_noop(src_dir, tmp_path):
+    wled = _seed_serving(src_dir, tmp_path, 2)
+    with SieveService(_cfg(str(tmp_path)), _settings()) as svc:
+        fol = LedgerFollower(svc, refresh_s=1.0)
+        old = svc.index
+        # idempotent re-record: new mtime, identical content/checksum
+        wled.record(next(iter(wled.completed().values())))
+        assert fol.poll_once() == "unchanged"
+        assert svc.index is old
+        assert svc._refreshes == 0
+
+
+def test_follower_corrupt_read_is_skipped_refresh(src_dir, tmp_path, memsink):
+    wled = _seed_serving(src_dir, tmp_path, 2)
+    ledger_path = tmp_path / LEDGER_NAME
+    good = ledger_path.read_text()
+    with SieveService(_cfg(str(tmp_path)), _settings()) as svc:
+        fol = LedgerFollower(svc, refresh_s=1.0)
+        old = svc.index
+        ledger_path.write_text(good[: len(good) // 2])  # torn write
+        assert fol.poll_once() == "failed"
+        assert svc.index is old  # keeps serving the previous snapshot
+        assert svc._refresh_failed == 1
+        # recovery: the writer restores a (longer) good ledger
+        for r in _remaining(src_dir, 2):
+            wled.record(r)
+        assert fol.poll_once() == "swapped"
+        assert svc.index.covered_hi > old.covered_hi
+    ev = [x for x in memsink.records
+          if x["event"] == "service_refresh_failed"]
+    assert len(ev) == 1 and "LedgerCorrupt" in ev[0]["reason"]
+    validate_record(ev[0])
+
+
+def test_follower_vanished_ledger_never_regresses(src_dir, tmp_path, memsink):
+    _seed_serving(src_dir, tmp_path, 2)
+    ledger_path = tmp_path / LEDGER_NAME
+    with SieveService(_cfg(str(tmp_path)), _settings()) as svc:
+        fol = LedgerFollower(svc, refresh_s=1.0)
+        old = svc.index
+        # the coordinator's quarantine window: the file is gone between
+        # polls — an empty snapshot would regress covered_hi, so skip
+        ledger_path.unlink()
+        assert fol.poll_once() == "failed"
+        assert svc.index is old
+        assert svc.index.covered_hi == old.covered_hi
+    ev = [x for x in memsink.records
+          if x["event"] == "service_refresh_failed"]
+    assert len(ev) == 1 and "regress" in ev[0]["reason"]
+
+
+def test_svc_refresh_corrupt_chaos_then_recovery(src_dir, tmp_path):
+    wled = _seed_serving(src_dir, tmp_path, 2)
+    with SieveService(_cfg(str(tmp_path)), _settings()) as svc:
+        fol = LedgerFollower(svc, refresh_s=1.0)
+        svc.inject_chaos(f"svc_refresh_corrupt:any@s{fol.attempts + 1}")
+        for r in _remaining(src_dir, 2):
+            wled.record(r)
+        assert fol.poll_once() == "failed"  # directive consumed, one-shot
+        assert fol.poll_once() == "swapped"  # very next poll recovers
+        assert svc.index.covered_hi == N + 1
+
+
+def test_follower_thread_follows_live_writer(src_dir, tmp_path):
+    wled = _seed_serving(src_dir, tmp_path, 2)
+    settings = _settings(refresh_s=0.05)
+    with SieveService(_cfg(str(tmp_path)), settings) as svc:
+        assert svc.follower is not None
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            h0 = cli.health()
+            for r in _remaining(src_dir, 2):
+                wled.record(r)
+                time.sleep(0.1)
+            deadline = time.monotonic() + 10
+            while cli.health()["refreshes"] < 1:
+                assert time.monotonic() < deadline, "follower never swapped"
+                time.sleep(0.05)
+            h1 = cli.health()
+            assert h1["covered_hi"] > h0["covered_hi"]
+            assert cli.pi(N - 1) == o_pi(N - 1)
+
+
+# --- graceful drain ----------------------------------------------------------
+
+
+def test_drain_sheds_typed_draining(src_dir, memsink):
+    with SieveService(_cfg(str(src_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            assert cli.pi(100) == o_pi(100)
+            svc.drain()
+            r = cli.query("pi", x=100)
+            assert r["error"] == "draining"
+            assert "draining" in r["detail"]
+            assert cli.health()["draining"] is True
+            assert svc.wait_drained(5)
+            assert svc.stats()["draining_replies"] == 1
+            # the listener is closed: new connections are refused
+            host, port = svc.addr.split(":")
+            with pytest.raises(OSError):
+                socket.create_connection((host, int(port)), timeout=1)
+    ev = [x for x in memsink.records if x["event"] == "service_drain"]
+    assert len(ev) == 1
+    validate_record(ev[0])
+
+
+def test_drain_answers_inflight_queries(src_dir):
+    settings = _settings(cold_delay_s=0.3)
+    with SieveService(_cfg(str(src_dir)), settings) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli, \
+                ServiceClient(svc.addr, timeout_s=30) as cli2:
+            box = {}
+
+            def fire():
+                box["reply"] = cli.query("pi", x=150_000)  # cold: ~0.3 s
+
+            t = threading.Thread(target=fire)
+            t.start()
+            time.sleep(0.1)  # inside the simulated cold latency
+            svc.drain()
+            shed = cli2.query("pi", x=100)
+            assert shed["error"] == "draining"
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert box["reply"]["ok"], box["reply"]
+            assert box["reply"]["value"] == o_pi(150_000)
+            assert svc.wait_drained(10)
+
+
+def test_shutdown_wire_message_drains(src_dir):
+    with SieveService(_cfg(str(src_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            r = cli.shutdown()
+            assert r["ok"] and r["draining"]
+            assert cli.query("pi", x=100)["error"] == "draining"
+            assert svc.wait_drained(5)
+
+
+def test_svc_drain_chaos_directive(src_dir):
+    with SieveService(_cfg(str(src_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            svc.inject_chaos(f"svc_drain:any@s{svc._seq + 1}")
+            r = cli.query("pi", x=100)
+            assert r["error"] == "draining"
+            assert svc._draining
+
+
+# --- replica_down + CallTimeout ----------------------------------------------
+
+
+def test_replica_down_drops_connections(src_dir):
+    with SieveService(_cfg(str(src_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=5) as cli:
+            svc.inject_chaos(f"replica_down:any@s{svc._seq + 1}:0.4")
+            with pytest.raises((ConnectionError, OSError)):
+                cli.pi(100)
+            # inside the window a fresh connection is dropped too
+            with ServiceClient(svc.addr, timeout_s=5) as cli2, \
+                    pytest.raises((ConnectionError, OSError)):
+                cli2.pi(100)
+        deadline = time.monotonic() + 5
+        while True:  # after the window the replica answers again
+            try:
+                with ServiceClient(svc.addr, timeout_s=5) as cli3:
+                    assert cli3.pi(100) == o_pi(100)
+                break
+            except (ConnectionError, OSError):
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+
+
+def test_call_timeout_closes_desynced_socket(src_dir):
+    """Regression (ISSUE 8 satellite): a timed-out call used to leave its
+    request in flight, so the next call read the PREVIOUS reply."""
+    with SieveService(_cfg(str(src_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=0.3) as cli:
+            svc.inject_chaos(f"svc_stall:any@s{svc._seq + 1}:1.0")
+            with pytest.raises(CallTimeout) as ei:
+                cli.pi(100)
+            assert ei.value.kind == "timeout"
+            # the poisoned connection fails fast — it must never hand the
+            # stalled pi(100) reply to a different request
+            with pytest.raises(ConnectionError, match="desynced"):
+                cli.pi(200_000_000)
+
+
+# --- ReplicaSet --------------------------------------------------------------
+
+
+def _dead_addr() -> str:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here anymore
+    return f"127.0.0.1:{port}"
+
+
+def test_replicaset_fails_over_from_dead_replica(src_dir):
+    with SieveService(_cfg(str(src_dir)), _settings()) as svc:
+        with ReplicaSet([_dead_addr(), svc.addr], timeout_s=10,
+                        rounds=2, backoff_base_s=0.01) as rs:
+            assert rs.pi(30_000) == o_pi(30_000)
+            assert rs.nth_prime(100) == int(P[99])
+
+
+def test_replicaset_fails_over_from_draining_replica(src_dir):
+    s1 = SieveService(_cfg(str(src_dir)), _settings()).start()
+    s2 = SieveService(_cfg(str(src_dir)), _settings()).start()
+    try:
+        addrs = [s1.addr, s2.addr]  # before drain closes s1's listener
+        s1.drain()
+        with ReplicaSet(addrs, timeout_s=10,
+                        rounds=2, backoff_base_s=0.01) as rs:
+            for _ in range(4):  # round-robin must steer off s1 every time
+                assert rs.pi(30_000) == o_pi(30_000)
+            assert s2.stats()["requests"] >= 4
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_replicaset_never_retries_bad_request(src_dir):
+    with SieveService(_cfg(str(src_dir)), _settings()) as svc:
+        with ReplicaSet([svc.addr, svc.addr], timeout_s=10) as rs:
+            r = rs.query("count", lo=9, hi=4)
+            assert r["error"] == "bad_request"
+            assert rs.failovers == 0  # returned from the first replica
+            with pytest.raises(ServiceError) as ei:
+                rs.count(9, 4)
+            assert ei.value.kind == "bad_request"
+
+
+def test_replicaset_all_dead_is_typed_unavailable():
+    rs = ReplicaSet([_dead_addr(), _dead_addr()], timeout_s=2,
+                    rounds=2, backoff_base_s=0.01, backoff_cap_s=0.02)
+    with pytest.raises(ServiceError) as ei:
+        rs.pi(100)
+    assert ei.value.kind == "unavailable"
+
+
+# --- wire chaos gate + health fields -----------------------------------------
+
+
+def test_wire_chaos_gate_refuses_and_events(src_dir, memsink):
+    with SieveService(_cfg(str(src_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            r = cli.inject_chaos("svc_shed:any@s1")
+            assert not r["ok"] and r["error"] == "bad_request"
+            assert "--allow-chaos" in r["detail"]
+            assert len(svc.chaos) == 0  # nothing was scheduled
+            assert cli.pi(100) == o_pi(100)  # and nothing sheds
+    ev = [x for x in memsink.records
+          if x["event"] == "service_chaos_refused"]
+    assert len(ev) == 1 and ev[0]["spec"] == "svc_shed:any@s1"
+    validate_record(ev[0])
+
+
+def test_wire_chaos_allowed_when_enabled(src_dir):
+    with SieveService(_cfg(str(src_dir)),
+                      _settings(wire_chaos=True)) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            r = cli.inject_chaos(f"svc_shed:any@s{svc._seq + 1}")
+            assert r["ok"] and r["injected"] == 1
+            assert cli.query("pi", x=100)["error"] == "overloaded"
+
+
+def test_health_freshness_fields(src_dir):
+    with SieveService(_cfg(str(src_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            h = cli.health()
+            assert h["covered_hi"] == svc.index.covered_hi
+            assert h["refreshes"] == 0
+            assert h["draining"] is False
+            assert h["snapshot_age_s"] >= 0
+            s = cli.stats()
+            for key in ("refreshes", "refresh_failed", "refresh_attempts",
+                        "snapshot_age_s", "draining"):
+                assert key in s
+
+
+def test_trace_report_prints_refresh_line():
+    from tools.trace_report import service_report
+
+    spans = [
+        {"name": "service.refresh", "ph": "X", "ts": 1000, "dur": 500,
+         "args": {"outcome": "swapped", "covered_hi": 50_001,
+                  "prev_covered_hi": 25_000}},
+        {"name": "service.refresh", "ph": "X", "ts": 3000, "dur": 200,
+         "args": {"outcome": "failed", "reason": "chaos"}},
+        {"name": "rpc.query", "ph": "X", "ts": 5000, "dur": 400,
+         "args": {"op": "pi", "outcome": "ok", "source": "index"}},
+    ]
+    lines = service_report(spans)
+    joined = "\n".join(lines)
+    assert "ledger follow" in joined
+    assert "1 refresh(es) swapped" in joined
+    assert "covered_hi=50001" in joined
+    # refresh-only traces still render the freshness line
+    assert "ledger follow" in "\n".join(service_report(spans[:2]))

@@ -145,6 +145,49 @@ def test_open_readonly_refuses_foreign_config(ledger_dir):
         Ledger.open_readonly(_cfg(str(ledger_dir), n=2 * N))
 
 
+def test_open_readonly_toctou_vanish_reads_empty(
+    tmp_path, ledger_dir, monkeypatch
+):
+    """ISSUE 8 satellite: the file vanishing between ``exists()`` and
+    ``read_text()`` (the coordinator's quarantine ``os.replace`` window)
+    must read as an empty snapshot, never escape as FileNotFoundError."""
+    path = tmp_path / LEDGER_NAME
+    path.write_text((ledger_dir / LEDGER_NAME).read_text())
+    orig = Path.read_text
+
+    def vanish_then_read(self, *a, **kw):
+        if self.name == LEDGER_NAME and self.exists():
+            self.unlink()  # quarantined between the stat and the read
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(Path, "read_text", vanish_then_read)
+    led = Ledger.open_readonly(_cfg(str(tmp_path)))
+    assert led.read_only
+    assert led.completed() == {}  # same as a ledger that never existed
+
+
+def test_open_readonly_v1_loads_unverified(tmp_path, ledger_dir, memsink):
+    """ISSUE 8 satellite: a checksum-less v1 ledger loads, but never
+    silently — open_readonly flags it and the service events it."""
+    data = json.loads((ledger_dir / LEDGER_NAME).read_text())
+    del data["version"], data["checksum"]  # what an old build wrote
+    (tmp_path / LEDGER_NAME).write_text(json.dumps(data))
+    led = Ledger.open_readonly(_cfg(str(tmp_path)))
+    assert led.unverified
+    assert led.checksum is not None  # computed, so live-follow still works
+    assert len(led.completed()) == 4
+    # the fresh v2 ledger is verified — no warning there
+    assert not Ledger.open_readonly(_cfg(str(ledger_dir))).unverified
+    svc = SieveService(_cfg(str(tmp_path)), _settings())
+    try:
+        ev = [x for x in memsink.records if x["event"] == "ledger_unverified"]
+        assert len(ev) == 1 and ev[0]["path"].endswith(LEDGER_NAME)
+        validate_record(ev[0])
+        assert svc.index.covered_hi == N + 1  # the v1 entries all served
+    finally:
+        svc.cold.close()
+
+
 # --- seed memoization (satellite b) ------------------------------------------
 
 
@@ -473,6 +516,17 @@ def test_service_smoke_tool(tmp_path):
     )
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "SERVICE_SMOKE_OK" in proc.stdout
+
+
+def test_failover_smoke_tool(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "failover_smoke.py"),
+         "--keep", str(tmp_path / "work")],
+        env=env, cwd=str(REPO), capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "FAILOVER_SMOKE_OK" in proc.stdout
 
 
 def test_serve_cli_end_to_end(ledger_dir):

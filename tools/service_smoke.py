@@ -105,6 +105,7 @@ def main(argv: list[str] | None = None) -> int:
         settings = ServiceSettings(
             workers=4, queue_limit=32, default_deadline_s=10.0,
             cold_chunk=1 << 17, cold_delay_s=0.3,
+            wire_chaos=True,  # phase 4 injects faults over the wire
         )
         svc = SieveService(cfg, settings).start()
         cli = ServiceClient(svc.addr, timeout_s=30)

@@ -140,9 +140,34 @@ def service_report(spans: list[dict]) -> list[str]:
     by reply source (sieve/service/ rpc.query spans). Empty when the
     trace has no service traffic."""
     rpc = [e for e in spans if e["name"] == "rpc.query"]
-    if not rpc:
+    refreshes = [e for e in spans if e["name"] == "service.refresh"]
+    if not rpc and not refreshes:
         return []
     lines = ["query service (rpc.query requests):"]
+    if refreshes:
+        # live-follow freshness (ISSUE 8): how often the snapshot swapped,
+        # how often a refresh was skipped, and how stale the last swapped
+        # snapshot is at the end of the trace
+        swapped = [e for e in refreshes
+                   if e.get("args", {}).get("outcome") == "swapped"]
+        failed = len(refreshes) - len(swapped)
+        trace_end = max(e["ts"] + e["dur"] for e in spans)
+        if swapped:
+            last = max(swapped, key=lambda e: e["ts"])
+            staleness_s = (trace_end - (last["ts"] + last["dur"])) / 1e6
+            lines.append(
+                f"  ledger follow: {len(swapped)} refresh(es) swapped, "
+                f"{failed} skipped; covered_hi="
+                f"{last.get('args', {}).get('covered_hi', '?')}, snapshot "
+                f"{staleness_s:.3f}s stale at trace end"
+            )
+        else:
+            lines.append(
+                f"  ledger follow: 0 refreshes swapped, {failed} skipped "
+                "(serving the startup snapshot)"
+            )
+    if not rpc:
+        return lines
     by_outcome: dict[tuple[str, str], list[float]] = {}
     for e in rpc:
         a = e.get("args", {})
