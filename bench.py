@@ -1,7 +1,8 @@
 """Benchmark: the shallow AND depth regimes of the pallas sieve, plus the
-host-prepare pipeline and the fused-reduction bandwidth model.
+host-prepare pipeline, the fused-reduction bandwidth model, and the
+query-service latency profile.
 
-Prints FOUR JSON lines {"metric", "value", "unit", "vs_baseline"}:
+Prints FIVE JSON lines {"metric", "value", "unit", "vs_baseline"}:
 
 1. pi(1e9), odds packing, tpu-pallas backend — the shallow regime.
    Baseline: BASELINE.md's measured CPU floor — pi(1e9) segmented numpy
@@ -28,10 +29,18 @@ Prints FOUR JSON lines {"metric", "value", "unit", "vs_baseline"}:
    bit-exact fused-vs-split parity check of that same segment.
    vs_baseline = 0.55 / ratio, so >= 1 means the "one bitset pass
    eliminated" target of ISSUE 3 is met. Host-only: emitted anywhere.
+5. Query-service latency (ISSUE 9): p50/p95 ms per op measured from the
+   ``rpc.query`` trace spans of a mixed hot/cold workload against an
+   in-process SieveService over a freshly sieved checkpoint dir. The
+   headline value is the overall p95 in ms (unit ``ms_p95`` — gated
+   UPWARD by tools/bench_compare.py: a >10% p95 increase between rounds
+   fails); vs_baseline = 50 ms budget / p95, so >= 1 is within budget.
+   Host-only: emitted anywhere.
 
 Exact parity is asserted before any number is printed — the depth line
 against a cpu-numpy run of the same segment: a fast wrong sieve scores
-zero.
+zero. The service line asserts every reply exact against the index
+oracle before timing counts.
 """
 
 from __future__ import annotations
@@ -265,11 +274,98 @@ def fused_reduction_metric() -> None:
     )
 
 
+def _pctile(vals: list[float], q: float) -> float:
+    """Nearest-rank percentile (no interpolation: a latency sample that
+    happened is reported, one that didn't is not)."""
+    s = sorted(vals)
+    return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+
+def service_latency_metric() -> None:
+    """Service-plane latency line (runs on any platform): p50/p95 ms per
+    op from the ``rpc.query`` spans of a mixed workload — hot index
+    prefix counts, windowed counts through the materialize tier, and
+    cold queries past covered_hi that exercise the batched cold plane.
+    Every reply is asserted exact against a host oracle first."""
+    import tempfile
+
+    import numpy as np
+
+    from sieve import trace
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+    from sieve.seed import seed_primes
+    from sieve.service import ServiceClient, ServiceSettings, SieveService
+
+    n = 2_000_000
+    chunk = 1 << 18
+    oracle = seed_primes(n + 9 * chunk)
+
+    def o_pi(x: int) -> int:
+        return int(np.searchsorted(oracle, x, side="right"))
+
+    with tempfile.TemporaryDirectory(prefix="sieve_bench_svc") as ck:
+        cfg = SieveConfig(
+            n=n, backend="cpu-numpy", packing="odds", n_segments=8,
+            checkpoint_dir=ck, quiet=True,
+        )
+        run_local(cfg)
+        trace.enable()
+        trace.drain_events()  # only this workload's spans are measured
+        settings = ServiceSettings(
+            workers=4, queue_limit=64, cold_chunk=chunk, refresh_s=0.0,
+        )
+        with SieveService(cfg, settings) as svc, \
+                ServiceClient(svc.addr, timeout_s=60) as cli:
+            for i in range(150):  # hot: O(log segments) prefix counts
+                x = (7919 * (i + 1)) % n
+                assert cli.pi(x) == o_pi(x), f"pi({x}) parity failure"
+            for i in range(50):   # hot: windowed counts (materialize tier)
+                lo = (104_729 * (i + 1)) % (n - 60_000)
+                want = o_pi(lo + 50_000 - 1) - o_pi(lo - 1)
+                assert cli.count(lo, lo + 50_000) == want, \
+                    f"count({lo}) parity failure"
+            for i in range(8):    # cold: one fresh chunk each, batched
+                x = n + (i + 1) * chunk - 1
+                assert cli.pi(x) == o_pi(x), f"cold pi({x}) parity failure"
+        events, _dropped = trace.drain_events()
+        trace.disable()
+    by_op: dict[str, list[float]] = {}
+    for e in events:
+        if e.get("name") == "rpc.query":
+            op = (e.get("args") or {}).get("op", "?")
+            by_op.setdefault(op, []).append(e["dur"] / 1000.0)  # us -> ms
+    assert by_op, "no rpc.query spans captured"
+    all_ms = [v for vals in by_op.values() for v in vals]
+    p95 = _pctile(all_ms, 0.95)
+    budget_ms = 50.0
+    print(
+        json.dumps(
+            {
+                "metric": "service_query_latency_p95_ms",
+                "value": round(p95, 3),
+                "unit": "ms_p95",
+                "vs_baseline": round(budget_ms / p95, 3) if p95 else None,
+                "p50_ms": round(_pctile(all_ms, 0.5), 3),
+                "ops": {
+                    op: {
+                        "n": len(vals),
+                        "p50_ms": round(_pctile(vals, 0.5), 3),
+                        "p95_ms": round(_pctile(vals, 0.95), 3),
+                    }
+                    for op, vals in sorted(by_op.items())
+                },
+            }
+        )
+    )
+
+
 def main() -> int:
     shallow_metric()
     depth_metric()
     host_prepare_metric()
     fused_reduction_metric()
+    service_latency_metric()
     return 0
 
 

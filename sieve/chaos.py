@@ -56,6 +56,18 @@ Replication kinds (ISSUE 8; the live-follow / failover / drain plane):
                               all later ones are shed as typed
                               ``draining`` while queued work completes.
 
+Batched cold plane (ISSUE 9; drawn by the ColdBatcher on its own batch
+counter, like the follower draws refresh attempts):
+
+* ``svc_batch_partial:any@sK:i`` chunk ``i`` (0-based, in sorted chunk
+                              order, default 0) of the K-th *batch
+                              dispatch* fails before it reaches the
+                              backend: its waiters get a typed
+                              ``degraded`` reply while every surviving
+                              chunk in the same batch still answers
+                              exact — the batch path must degrade
+                              per-chunk, never per-batch.
+
 ``worker`` is an integer id, or ``any``/``*`` for whichever worker draws
 the segment (the pull model makes a specific id probabilistic, ``any``
 deterministic). Directives are transported to the worker inside the
@@ -86,11 +98,14 @@ KINDS = (
     "svc_refresh_corrupt",
     "replica_down",
     "svc_drain",
+    "svc_batch_partial",
 )
 # kinds handled by the query service (sieve/service/); the cluster plane
 # ignores these and vice versa. Request-scoped kinds key on the request
 # sequence number; svc_refresh_corrupt keys on the refresh attempt
-# number and is drawn by the LedgerFollower, not the dispatcher.
+# number and is drawn by the LedgerFollower, not the dispatcher;
+# svc_batch_partial keys on the batch-dispatch number and is drawn by
+# the ColdBatcher.
 SERVICE_KINDS = (
     "svc_stall",
     "svc_shed",
@@ -98,6 +113,7 @@ SERVICE_KINDS = (
     "svc_refresh_corrupt",
     "replica_down",
     "svc_drain",
+    "svc_batch_partial",
 )
 SERVICE_REQUEST_KINDS = (
     "svc_stall",
@@ -118,6 +134,8 @@ DEFAULT_PARAM: dict[str, float | None] = {
     "svc_refresh_corrupt": None,
     "replica_down": 1.0,
     "svc_drain": None,
+    # param = 0-based index of the chunk to fail, in sorted batch order
+    "svc_batch_partial": 0.0,
 }
 
 

@@ -38,6 +38,14 @@ or flushes — a concurrent reader must not race the coordinator's
 atomic-replace or steal its corrupt-file recovery. A read-only ledger
 raises on :meth:`Ledger.record`.
 
+The ledger is also the cold-compute write-back target (ISSUE 9): a
+query server started with ``--persist-cold`` is the designated *writer*
+for its checkpoint dir and records each batch of cold chunk results via
+:meth:`Ledger.record_many` — one atomic fsync'd flush per batch, entries
+keyed ``COLD_SEG_BASE + lo`` so a chunk recomputed (or re-clipped) is
+overwritten, never double-counted. Replicas inherit the work through the
+same live-follow path as coordinator writes.
+
 Live-following readers (ISSUE 8) poll :func:`ledger_fingerprint` (mtime
 + size, no read) and re-open when it moves; :attr:`Ledger.checksum`
 identifies the loaded content so an atomic rewrite of identical bytes is
@@ -66,6 +74,11 @@ if TYPE_CHECKING:
 
 LEDGER_NAME = "sieve_ledger.json"
 LEDGER_VERSION = 2
+
+# Cold write-back entries (ISSUE 9) key on COLD_SEG_BASE + lo: far above
+# any sieving run's seg_id space, deterministic per chunk (idempotent
+# re-record), and unique because chunks at distinct lo never collide.
+COLD_SEG_BASE = 1 << 40
 
 # completed-dict entries: '"<seg_id>": {flat object}' — SegmentResult
 # serializations are flat, so a non-greedy brace match per entry is exact
@@ -276,18 +289,37 @@ class Ledger:
             f"(delete {qpath} once investigated)."
         )
 
+    def recorded_hi(self, seg_id: int) -> int:
+        """``hi`` of the entry currently recorded under ``seg_id`` (0 if
+        none) — lets the cold write-back (ISSUE 9) skip a clipped
+        recompute of a chunk that is already persisted to a larger hi,
+        so ledger coverage never shrinks under racing queries."""
+        e = self._entries.get(seg_id)
+        return int(e.get("hi", 0)) if e else 0
+
     def completed(self) -> dict[int, SegmentResult]:
         return {k: SegmentResult.from_dict(v) for k, v in self._entries.items()}
 
     def record(self, res: SegmentResult) -> None:
         """Idempotent: the ledger keys on segment id, so a segment processed
         twice (e.g. after worker-failure reassignment) is counted once."""
+        self.record_many([res])
+
+    def record_many(self, results: list[SegmentResult]) -> None:
+        """Record a batch of results with ONE atomic fsync'd flush — the
+        cold-compute write-back path (ISSUE 9) persists every chunk of a
+        batch dispatch in a single temp-file + rename, so a crash leaves
+        either the whole batch or none of it (same idempotent seg_id
+        keying as :meth:`record`)."""
         if self.read_only:
             raise LedgerMismatch(
                 f"ledger at {self.path} was opened read-only; record() is "
                 "reserved for the owning coordinator"
             )
-        self._entries[res.seg_id] = res.to_dict()
+        if not results:
+            return
+        for res in results:
+            self._entries[res.seg_id] = res.to_dict()
         self._flush()
 
     def _flush(self) -> None:

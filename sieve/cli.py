@@ -193,6 +193,12 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-s", type=float, default=None,
                    help="graceful-drain budget after SIGTERM/shutdown "
                         "(default SIEVE_SVC_DRAIN_S/5.0)")
+    p.add_argument("--persist-cold", action="store_true",
+                   help="write cold chunk results back into the checkpoint "
+                        "dir's ledger (this server becomes its designated "
+                        "writer; covered_hi grows under read traffic and "
+                        "replicas following the file inherit the work). "
+                        "Default OFF / SIEVE_SVC_PERSIST_COLD")
     p.add_argument("--allow-chaos", action="store_true",
                    help="accept wire-injected chaos messages (default OFF: "
                         "a refused injection gets a typed bad_request and "
@@ -241,6 +247,11 @@ def _serve(argv: list[str]) -> int:
         overrides["drain_s"] = args.drain_s
     if args.allow_chaos:
         overrides["wire_chaos"] = True
+    if args.persist_cold:
+        if not args.checkpoint_dir:
+            raise ValueError("--persist-cold needs --checkpoint-dir (the "
+                             "ledger is the write-back target)")
+        overrides["persist_cold"] = True
     settings = ServiceSettings.from_env(**overrides)
 
     file_sink = None

@@ -212,5 +212,32 @@ def mark_words(
     ))
 
 
+@functools.partial(
+    jax.jit, static_argnames=("Wpad", "twin_kind", "periods")
+)
+def mark_words_batch(
+    Wpad, twin_kind, periods, nbits, patterns, m2, r2, K2, rcp2, act2,
+    corr_idx, corr_mask, pair_mask,
+):
+    """Batched `mark_words`: every traced argument gains a leading batch
+    axis (``patterns`` is a tuple of ``[B, period]`` arrays) and the
+    whole batch runs as ONE device dispatch via vmap — the cold-compute
+    plane (ISSUE 9) stacks the distinct chunks of a queue drain here so
+    N chunks cost one launch instead of N round trips. Returns
+    ``uint32[B, 4]`` (count, pairs, first32, last32 per segment)."""
+
+    def one(nbits_i, patterns_i, m2_i, r2_i, K2_i, rcp2_i, act2_i,
+            ci_i, cm_i, pm_i):
+        return pack4(*mark_words_impl(
+            Wpad, twin_kind, periods, nbits_i, patterns_i,
+            m2_i, r2_i, K2_i, rcp2_i, act2_i, ci_i, cm_i, pm_i,
+        ))
+
+    return jax.vmap(one)(
+        nbits, patterns, m2, r2, K2, rcp2, act2,
+        corr_idx, corr_mask, pair_mask,
+    )
+
+
 def next_pow2(x: int) -> int:
     return 1 << max(0, (x - 1)).bit_length()

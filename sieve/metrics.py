@@ -83,6 +83,12 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "service_drain": {"queued", "inflight"},
     "service_chaos_refused": {"spec"},
     "ledger_unverified": {"path"},
+    # batched cold plane (ISSUE 9): one service_batched per backend
+    # dispatch — "chunks" is the batch size (also observed by the
+    # service.batch_chunks histogram), "persisted" how many results were
+    # written back to the ledger (0 unless --persist-cold), "failed" how
+    # many chunks were chaos-failed out of the batch pre-dispatch.
+    "service_batched": {"chunks", "lo", "hi", "ms", "persisted", "failed"},
 }
 
 

@@ -22,6 +22,14 @@ monotonic ``covered_hi``), drains gracefully on SIGTERM/``shutdown``
 (typed ``draining`` sheds, zero dropped in-flight answers), and clients
 spread across replicas with :class:`ReplicaSet` — so a rolling restart
 of the query plane is invisible except as failovers.
+
+Batched cold plane (ISSUE 9): the admission queue doubles as the
+batching point — a :class:`~sieve.service.server.ColdBatcher` drains
+every distinct cold chunk registered by queued requests into ONE
+backend dispatch (`SieveWorker.process_segments`; a single vmapped
+device launch on jax), and ``--persist-cold`` writes the results back
+into the ledger so ``covered_hi`` grows under read traffic and
+restarts/replicas answer yesterday's cold ranges from the index.
 """
 
 from sieve.service.client import (
@@ -33,6 +41,7 @@ from sieve.service.client import (
 from sieve.service.index import QueryCtx, SieveIndex
 from sieve.service.server import (
     BadRequest,
+    ColdBatcher,
     DeadlineExceeded,
     Degraded,
     Draining,
@@ -45,6 +54,7 @@ from sieve.service.server import (
 __all__ = [
     "BadRequest",
     "CallTimeout",
+    "ColdBatcher",
     "DeadlineExceeded",
     "Degraded",
     "Draining",

@@ -14,7 +14,15 @@ One :class:`SieveService` owns four tiers:
   the :class:`~sieve.worker.SieveWorker` seam, chunked on a fixed grid
   so concurrent overlapping queries coalesce: one leader computes a
   chunk, followers wait on its flight and share the result, and the
-  result is cached so a repeated cold query becomes hot.
+  result is cached so a repeated cold query becomes hot. The admission
+  queue is the batching point (ISSUE 9): a :class:`ColdBatcher` thread
+  drains every distinct chunk registered by queued requests and issues
+  ONE backend dispatch for the whole sorted list through the
+  ``SieveWorker.process_segments`` seam — on the jax backend the
+  chunks stack into a single vmapped device launch, so M overlapping
+  cold queries cost at most distinct-chunk dispatches, not M round
+  trips. A chaos-failed chunk (``svc_batch_partial``) degrades only
+  its own waiters; surviving chunks in the same batch answer exact.
 * **degradation** — a circuit breaker around the backend: a failure
   streak (or an injected ``backend_down``) opens it for a cooldown,
   cold queries fail fast with a typed ``degraded`` reply, and the
@@ -33,6 +41,15 @@ Replication (ISSUE 8) adds two lifecycle behaviors on top:
   ``covered_hi`` is monotonic per process (a regressing or corrupt or
   mid-quarantine read is a *skipped* refresh with a
   ``service_refresh_failed`` event, never a crash and never a shrink).
+* **cold write-back** (ISSUE 9) — with ``--persist-cold`` this server
+  is the designated *writer* for its checkpoint dir: every batch of
+  cold chunk results is recorded into the ledger via one checksummed
+  atomic fsync'd flush (``Ledger.record_many``), keyed
+  ``COLD_SEG_BASE + lo``. The ledger's ``covered_hi`` therefore grows
+  under read traffic; the server's own follower (and every replica
+  following the same file) swaps the extended coverage in through the
+  ordinary refresh path, so a restart — or a peer — answers yesterday's
+  cold ranges from the index.
 * **graceful drain** — SIGTERM or a ``shutdown`` control message flips
   the server to draining: the listener closes, queued work is answered
   to completion, new queries are shed as typed ``draining``, and
@@ -55,6 +72,7 @@ connection reader — health stays observable even when the queue is full.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
 import os
@@ -70,6 +88,7 @@ from sieve import trace
 from sieve.backends import make_worker
 from sieve.chaos import SERVICE_REQUEST_KINDS, ChaosSchedule, parse_chaos
 from sieve.checkpoint import (
+    COLD_SEG_BASE,
     Ledger,
     LedgerMismatch,
     ledger_fingerprint,
@@ -151,9 +170,15 @@ class ServiceSettings:
     # client could otherwise fault-inject a production server. The CLI
     # spells this --allow-chaos; --chaos-config schedules still apply.
     wire_chaos: bool = False
-    # test/chaos knob: extra latency per cold compute, to simulate a
-    # saturated backend deterministically (coalescing/shed scenarios)
+    # test/chaos knob: extra latency per cold *dispatch* (not per chunk:
+    # a batch of N chunks pays it once — exactly the economics batching
+    # buys), to simulate a saturated backend deterministically
     cold_delay_s: float = 0.0
+    # batched cold plane (ISSUE 9): write cold results back into the
+    # ledger (this server becomes the checkpoint dir's designated
+    # writer), and cap how many chunks one backend dispatch may carry
+    persist_cold: bool = False
+    batch_max_chunks: int = 128
 
     @classmethod
     def from_env(cls, **overrides: Any) -> "ServiceSettings":
@@ -181,6 +206,11 @@ class ServiceSettings:
             wire_chaos=os.environ.get("SIEVE_SVC_WIRE_CHAOS", "0")
             not in ("0", "", "false"),
             cold_delay_s=_env_float("SIEVE_SVC_COLD_DELAY_S", cls.cold_delay_s),
+            persist_cold=os.environ.get("SIEVE_SVC_PERSIST_COLD", "0")
+            not in ("0", "", "false"),
+            batch_max_chunks=_env_int(
+                "SIEVE_SVC_BATCH_MAX", cls.batch_max_chunks
+            ),
         )
         return dataclasses.replace(s, **overrides)
 
@@ -242,19 +272,51 @@ class ColdBackend:
 
     def count_range(self, lo: int, hi: int) -> int:
         """Exact primes in [lo, hi) via the backend, or raise Degraded."""
+        return int(self.count_ranges([(lo, hi)])[0].count)
+
+    def count_ranges(self, chunks: list[tuple[int, int]]):
+        """One backend dispatch for a sorted list of disjoint chunks
+        (ISSUE 9): returns a :class:`~sieve.worker.SegmentResult` per
+        chunk (seg_id ``COLD_SEG_BASE + lo`` — the ledger write-back
+        key), or raises :class:`Degraded` for the whole batch. The
+        ``cold_delay_s`` saturation knob is paid once per dispatch, not
+        per chunk — the economics the batch plane exists to buy. One
+        failure is ONE breaker strike regardless of batch size."""
         down, reason = self.is_down()
         if down:
             raise Degraded(f"cold backend down: {reason}")
         if self.settings.cold_delay_s > 0:
             # simulated saturation (deterministic chaos/smoke scenarios)
             time.sleep(self.settings.cold_delay_s)
-        seeds = seed_primes(math.isqrt(hi - 1))
+        # one seed set covering the largest hi serves every chunk (a
+        # superset of seeds is always safe for a smaller segment)
+        seeds = seed_primes(math.isqrt(max(hi for _, hi in chunks) - 1))
+        seg_ids = [COLD_SEG_BASE + lo for lo, _ in chunks]
         try:
             with self._lock:
                 if self._worker is None:
                     self._worker = make_worker(self.config)
-                with trace.span("query.cold", lo=lo, hi=hi):
-                    res = self._worker.process_segment(lo, hi, seeds, seg_id=0)
+                with trace.span(
+                    "query.cold", lo=chunks[0][0], hi=chunks[-1][1],
+                    chunks=len(chunks),
+                ):
+                    batch = getattr(self._worker, "process_segments", None)
+                    if batch is None:
+                        # minimal worker stubs (tests) expose only the
+                        # single-segment seam; loop it
+                        results = [
+                            self._worker.process_segment(
+                                lo, hi, seeds, seg_id=sid
+                            )
+                            for (lo, hi), sid in zip(chunks, seg_ids)
+                        ]
+                    else:
+                        results = batch(chunks, seeds, seg_ids=seg_ids)
+            for res in results:
+                if not res.is_sane():
+                    raise RuntimeError(
+                        f"insane result for chunk [{res.lo}, {res.hi})"
+                    )
         except Degraded:
             raise
         except Exception as e:
@@ -272,7 +334,7 @@ class ColdBackend:
             raise Degraded(f"cold backend error: {e}") from e
         with self._state_lock:
             self._fail_streak = 0
-        return int(res.count)
+        return results
 
     def close(self) -> None:
         with self._lock:
@@ -282,14 +344,153 @@ class ColdBackend:
 
 
 class _Flight:
-    """Single-flight slot: followers wait for the leader's result."""
+    """Single-flight slot: waiters block until the batcher resolves the
+    chunk with a full SegmentResult (or an error)."""
 
-    __slots__ = ("event", "value", "error")
+    __slots__ = ("event", "result", "error")
 
     def __init__(self) -> None:
         self.event = threading.Event()
-        self.value: int | None = None
+        self.result = None  # SegmentResult once resolved ok
         self.error: Exception | None = None
+
+
+class ColdBatcher:
+    """The queue-drain batching point of the cold plane (ISSUE 9).
+
+    Request handlers never call the backend directly any more: they
+    register a :class:`_Flight` per missing chunk, submit the keys here,
+    and wait. One daemon thread blocks for the first key, then drains
+    everything else that queued-up requests have registered in the
+    meantime, dedups (single-flight registration already guarantees one
+    key per chunk), sorts onto the grid, and issues ONE backend dispatch
+    for the whole list via :meth:`ColdBackend.count_ranges` — so M
+    concurrent cold queries over K distinct chunks cost at most
+    ``ceil(K / batch_max_chunks)`` dispatches. Completed results are
+    cached, optionally written back to the ledger
+    (:meth:`SieveService._persist_results`), and handed to every waiter.
+
+    ``svc_batch_partial`` chaos keys on :attr:`batches` — the dispatch
+    counter, this plane's own "segment" number (like the follower's
+    refresh attempts) — and fails one chunk *before* it reaches the
+    backend: its waiters get a typed ``degraded`` reply while the rest
+    of the batch still answers exact.
+
+    ``_drain_once`` is the whole state machine and is callable directly
+    (tests drive it synchronously); the thread only adds the blocking
+    loop.
+    """
+
+    def __init__(self, service: "SieveService"):
+        self.svc = service
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread: threading.Thread | None = None
+        self.batches = 0  # dispatch counter: the svc_batch_partial key
+
+    def start(self) -> "ColdBatcher":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="svc-batcher"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._q.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def submit(self, keys: list[tuple[int, int]]) -> None:
+        """Enqueue registered-leader chunk keys as ONE item — a request's
+        whole chunk list is never split across drains."""
+        self._q.put(list(keys))
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if self._drain_once(item) == "stop":
+                return
+
+    def _drain_once(self, first: list[tuple[int, int]]) -> str:
+        """Collect every key list queued behind ``first``, then dispatch
+        the sorted distinct set in ``batch_max_chunks``-bounded slices."""
+        keys = set(first)
+        stop = False
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                stop = True  # finish the batch in hand, then exit
+            else:
+                keys.update(item)
+        batch = sorted(keys)
+        cap = max(1, self.svc.settings.batch_max_chunks)
+        for i in range(0, len(batch), cap):
+            self._dispatch(batch[i:i + cap])
+        return "stop" if stop else "ok"
+
+    def _dispatch(self, batch: list[tuple[int, int]]) -> None:
+        svc = self.svc
+        self.batches += 1
+        t0 = trace.now_s()
+        failed: set[int] = set()
+        for d in svc.chaos.take_kinds(0, self.batches,
+                                      ("svc_batch_partial",)):
+            failed.add(int(d["param"] or 0))
+        good: list[tuple[int, int]] = []
+        for i, key in enumerate(batch):
+            if i in failed:
+                # per-chunk degradation: only THIS chunk's waiters see a
+                # typed degraded reply; the rest of the batch proceeds
+                self._resolve(key, None, Degraded(
+                    f"chaos svc_batch_partial: chunk [{key[0]}, {key[1]}) "
+                    f"failed in batch {self.batches}"
+                ))
+            else:
+                good.append(key)
+        persisted = 0
+        if good:
+            svc._bump("cold_dispatches")
+            svc._bump("cold_batched_chunks", len(good))
+            svc._bump("cold_computes", len(good))
+            try:
+                with trace.span("query.cold_batch", chunks=len(good),
+                                lo=good[0][0], hi=good[-1][1]):
+                    results = svc.cold.count_ranges(good)
+            except Exception as e:  # Degraded or internal: whole dispatch
+                for key in good:
+                    self._resolve(key, None, e)
+            else:
+                persisted = svc._persist_results(results)
+                with svc._cold_lock:
+                    for res in results:
+                        svc._cold_cache[(res.lo, res.hi)] = res
+                        svc._cold_cache.move_to_end((res.lo, res.hi))
+                    while (len(svc._cold_cache)
+                           > svc.settings.cold_cache_entries):
+                        svc._cold_cache.popitem(last=False)
+                for key, res in zip(good, results):
+                    self._resolve(key, res, None)
+        ms = round((trace.now_s() - t0) * 1000, 3)
+        registry().histogram("service.batch_chunks").observe(len(good))
+        svc.metrics.event(
+            "service_batched", quietable=True, chunks=len(good),
+            lo=batch[0][0], hi=batch[-1][1], ms=ms,
+            persisted=persisted, failed=len(batch) - len(good),
+        )
+
+    def _resolve(self, key, result, error) -> None:
+        svc = self.svc
+        with svc._cold_lock:
+            flight = svc._inflight.pop(key, None)
+        if flight is None:
+            return  # cancelled/raced away; the result is still cached
+        flight.result = result
+        flight.error = error
+        flight.event.set()
 
 
 class LedgerFollower:
@@ -424,6 +625,9 @@ _STATS = (
     "index_hits",
     "cold_computes",
     "cold_cache_hits",
+    "cold_dispatches",
+    "cold_batched_chunks",
+    "cold_persisted",
     "coalesced",
     "shed",
     "deadline_exceeded",
@@ -465,9 +669,17 @@ class SieveService:
         self.cold = ColdBackend(config, self.settings, self._on_degraded)
         self.chaos = ChaosSchedule(config.chaos_directives())
         self._cold_lock = threading.Lock()
-        self._cold_cache: dict[tuple[int, int], int] = {}
-        self._cold_order: list[tuple[int, int]] = []
+        # LRU of chunk results, most-recent at the end: O(1) hit
+        # (move_to_end) and O(1) eviction (popitem(last=False)) — the
+        # dict+list pair this replaces paid O(n) per eviction
+        self._cold_cache: "collections.OrderedDict" = collections.OrderedDict()
         self._inflight: dict[tuple[int, int], _Flight] = {}
+        self.batcher = ColdBatcher(self)
+        # --persist-cold: this server owns the checkpoint dir's ledger
+        # as a writer; only the batcher thread ever records into it
+        self._writer: Ledger | None = None
+        if self.settings.persist_cold and config.checkpoint_dir:
+            self._writer = Ledger.open(config)
         self._queue: "queue.Queue" = queue.Queue(self.settings.queue_limit)
         self._seq = 0
         self._seq_lock = threading.Lock()
@@ -533,6 +745,7 @@ class SieveService:
                                  name=f"svc-worker-{i}")
             w.start()
             self._threads.append(w)
+        self.batcher.start()
         if self.config.checkpoint_dir and self.settings.refresh_s > 0:
             self.follower = LedgerFollower(
                 self, self.settings.refresh_s
@@ -600,6 +813,7 @@ class SieveService:
                 pass
         for t in self._threads:
             t.join(timeout=5)
+        self.batcher.stop()
         self.cold.close()
         self._drained.set()
 
@@ -629,6 +843,7 @@ class SieveService:
         )
         out["snapshot_age_s"] = round(trace.now_s() - self._snapshot_ts, 3)
         out["draining"] = self._draining
+        out["persist_cold"] = self._writer is not None
         return out
 
     def _on_degraded(self, entering: bool, reason: str) -> None:
@@ -928,20 +1143,25 @@ class SieveService:
 
     def _count_upto(self, v: int, ctx: QueryCtx, deadline: float,
                     idx: SieveIndex) -> int:
-        """Primes in [2, v): index prefix + cold chunks past covered_hi."""
+        """Primes in [2, v): index prefix + cold chunks past covered_hi.
+
+        The WHOLE cold chunk list is computed up front and submitted to
+        the batcher in one go (ISSUE 9) — a request spanning K chunks
+        registers all K flights before the first wait, so one queue
+        drain sees them together and one backend dispatch answers them."""
         if v <= 2:
             return 0
         covered = min(v, idx.covered_hi)
         total = idx.count_upto(covered, ctx)
+        if covered >= v:
+            return total
+        chunks: list[tuple[int, int]] = []
         a = covered
         while a < v:
-            ctx.tick()
             b = min(_grid_next(a, self.settings.cold_chunk), v)
-            total += self._cold_count(a, b, ctx, deadline)
+            chunks.append((a, b))
             a = b
-            ctx.answered_hi = max(ctx.answered_hi, a)
-            ctx.count_so_far = max(ctx.count_so_far, total)
-        return total
+        return total + self._cold_counts(chunks, ctx, deadline, base=total)
 
     def _count(self, lo: int, hi: int, kind: str,
                ctx: QueryCtx, deadline: float, idx: SieveIndex) -> int:
@@ -985,8 +1205,11 @@ class SieveService:
                     f"nth_prime({k}): search passed MAX_HI={MAX_HI} "
                     f"with only {seen} primes"
                 )
+            # chunk-at-a-time on purpose: the search extent is unknown,
+            # so there is no chunk list to pre-submit (concurrent
+            # nth_prime searches still batch with each other's chunks)
             b = min(_grid_next(a, self.settings.cold_chunk), MAX_HI)
-            c = self._cold_count(a, b, ctx, deadline)
+            c = self._cold_counts([(a, b)], ctx, deadline, base=seen)
             if seen + c >= k:
                 return self._nth_in_window(a, b, k - seen, ctx, idx)
             seen += c
@@ -1057,57 +1280,91 @@ class SieveService:
         return (np.concatenate(out) if out
                 else np.zeros(0, dtype=np.int64))
 
-    # --- cold tier: single-flight + result cache -------------------------
+    # --- cold tier: single-flight registration + batched dispatch --------
 
-    def _cold_count(self, lo: int, hi: int, ctx: QueryCtx,
-                    deadline: float) -> int:
-        key = (lo, hi)
+    def _cold_counts(self, chunks: list[tuple[int, int]], ctx: QueryCtx,
+                     deadline: float, base: int = 0) -> int:
+        """Primes across ``chunks`` (ascending, disjoint, grid-aligned).
+
+        Single-flight registration happens for ALL chunks under one lock
+        pass — per chunk the request is either a cache hit, a follower
+        on an existing flight, or the registering leader — then every
+        leader key is submitted to the batcher at once and the request
+        waits on its flights in ascending order, so typed
+        ``deadline_exceeded`` partials report the same contiguous prefix
+        the sequential path did. ``base`` is the count already answered
+        below ``chunks[0]`` (keeps ``ctx.count_so_far`` exact)."""
+        plan: list[tuple[tuple[int, int], Any, _Flight | None, bool]] = []
+        submit: list[tuple[int, int]] = []
         with self._cold_lock:
-            cached = self._cold_cache.get(key)
-            if cached is not None:
+            for key in chunks:
+                res = self._cold_cache.get(key)
+                if res is not None:
+                    self._cold_cache.move_to_end(key)
+                    plan.append((key, res, None, False))
+                    continue
+                flight = self._inflight.get(key)
+                if flight is None:
+                    flight = self._inflight[key] = _Flight()
+                    submit.append(key)
+                    plan.append((key, None, flight, False))
+                else:
+                    plan.append((key, None, flight, True))
+        for key, res, _f, follower in plan:
+            if res is not None:
                 ctx.cold_cached = True
                 self._bump("cold_cache_hits")
-                return cached
-            flight = self._inflight.get(key)
-            leader = flight is None
-            if leader:
-                flight = self._inflight[key] = _Flight()
-        if not leader:
-            # follower: coalesce onto the in-flight computation
-            self._bump("coalesced")
-            self.metrics.event("service_coalesced", quietable=True,
-                               op="count_range", lo=lo, hi=hi)
-            if not flight.event.wait(timeout=max(0.0,
-                                                 deadline - trace.now_s())):
-                raise DeadlineExceeded(ctx.answered_hi, ctx.count_so_far)
-            if flight.error is not None:
-                if isinstance(flight.error, Degraded):
-                    raise Degraded(str(flight.error))
-                raise RuntimeError(f"coalesced compute failed: "
-                                   f"{flight.error}") from flight.error
+            elif follower:
+                self._bump("coalesced")
+                self.metrics.event("service_coalesced", quietable=True,
+                                   op="count_range", lo=key[0], hi=key[1])
+        if submit:
             ctx.cold = True
-            assert flight.value is not None
-            return flight.value
+            self.batcher.submit(submit)
+        total = 0
+        for key, res, flight, _follower in plan:
+            ctx.tick()
+            if res is None:
+                assert flight is not None
+                if not flight.event.wait(
+                    timeout=max(0.0, deadline - trace.now_s())
+                ):
+                    raise DeadlineExceeded(ctx.answered_hi, ctx.count_so_far)
+                if flight.error is not None:
+                    if isinstance(flight.error, Degraded):
+                        raise Degraded(str(flight.error))
+                    raise RuntimeError(
+                        f"batched cold compute failed: {flight.error}"
+                    ) from flight.error
+                ctx.cold = True
+                res = flight.result
+                assert res is not None
+            total += int(res.count)
+            ctx.answered_hi = max(ctx.answered_hi, key[1])
+            ctx.count_so_far = max(ctx.count_so_far, base + total)
+        return total
+
+    def _persist_results(self, results) -> int:
+        """Ledger write-back (``--persist-cold``): one atomic checksummed
+        flush per batch. Best-effort by design — a full disk must degrade
+        durability, never exactness of the replies in flight."""
+        if self._writer is None:
+            return 0
+        # never shrink: a chunk clipped at a query's v shares its seg_id
+        # (COLD_SEG_BASE + lo) with the full grid chunk — recording the
+        # clipped one over an already-persisted larger hi would shrink
+        # ledger coverage and strand every entry chained past it
+        keep = [r for r in results
+                if r.hi > self._writer.recorded_hi(r.seg_id)]
+        if not keep:
+            return 0
         try:
-            ctx.cold = True
-            self._bump("cold_computes")
-            value = self.cold.count_range(lo, hi)
-        except Exception as e:
-            flight.error = e
-            raise
-        else:
-            flight.value = value
-            with self._cold_lock:
-                self._cold_cache[key] = value
-                self._cold_order.append(key)
-                while len(self._cold_order) > self.settings.cold_cache_entries:
-                    old = self._cold_order.pop(0)
-                    self._cold_cache.pop(old, None)
-            return value
-        finally:
-            flight.event.set()
-            with self._cold_lock:
-                self._inflight.pop(key, None)
+            self._writer.record_many(keep)
+        except Exception:  # noqa: BLE001 — persistence never fails queries
+            registry().counter("service.persist_failed").inc()
+            return 0
+        self._bump("cold_persisted", len(keep))
+        return len(keep)
 
 
 def _grid_next(a: int, chunk: int) -> int:

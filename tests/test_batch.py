@@ -1,0 +1,294 @@
+"""The batched cold-compute plane (ISSUE 9).
+
+Covers: the ``process_segments`` seam — the default loop and the jax
+vmapped batch path are bit-exact against per-segment ``process_segment``
+across all three packings (including the sub-word CPU fallback inside a
+batch); a concurrent cold burst costs at most one dispatch per distinct
+grid chunk (counter-gated); ``svc_batch_partial`` degrades exactly one
+chunk of a batch while the rest answer exact; ``--persist-cold`` ledger
+write-back with the never-shrink guard and an all-hot restart; the
+OrderedDict LRU cold cache; ``service_batched`` EVENT_SCHEMA validation;
+and the bench_compare ``ms_p95`` regression gate.
+"""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sieve import metrics
+from sieve.backends.cpu_numpy import CpuNumpyWorker
+from sieve.chaos import ANY_WORKER, parse_chaos
+from sieve.checkpoint import COLD_SEG_BASE, Ledger
+from sieve.config import SieveConfig
+from sieve.coordinator import run_local
+from sieve.metrics import MemorySink, validate_record
+from sieve.seed import seed_primes
+from sieve.service import ServiceClient, ServiceSettings, SieveService
+from sieve.service.server import Degraded, _Flight
+from tools.bench_compare import compare
+
+N = 50_000
+PACKINGS = ["plain", "odds", "wheel30"]
+
+# mixed spans and alignments: a sub-word segment (CPU fallback inside a
+# device batch), unaligned bounds, and two equal-span chunks that land in
+# one vmap group on the jax path
+SEGMENTS = [
+    (2, 40),
+    (1_000, 9_000),
+    (9_000, 17_192),
+    (60_000, 68_192),
+    (68_192, 76_384),
+]
+
+P = seed_primes(200_000)
+
+
+def o_pi(x):
+    return int(np.searchsorted(P, x, side="right"))
+
+
+def o_count(lo, hi):
+    return int(np.searchsorted(P, hi, side="left")
+               - np.searchsorted(P, lo, side="left"))
+
+
+@pytest.fixture
+def memsink():
+    sink = MemorySink()
+    metrics.add_sink(sink)
+    yield sink
+    metrics.remove_sink(sink)
+
+
+@pytest.fixture(scope="module")
+def ledger_dir(tmp_path_factory):
+    path = tmp_path_factory.mktemp("batch_ledger")
+    run_local(_cfg(str(path)))
+    return path
+
+
+def _cfg(checkpoint_dir: str, **kw) -> SieveConfig:
+    base = dict(
+        n=N, backend="cpu-numpy", packing="wheel30", n_segments=4,
+        quiet=True, checkpoint_dir=checkpoint_dir,
+    )
+    base.update(kw)
+    return SieveConfig(**base)
+
+
+def _settings(**kw) -> ServiceSettings:
+    base = dict(
+        workers=2, queue_limit=16, default_deadline_s=10.0,
+        cold_chunk=1 << 16, refresh_s=0.0,
+    )
+    base.update(kw)
+    return ServiceSettings(**base)
+
+
+def _fields(res) -> tuple:
+    # everything but elapsed_s (wall time differs between paths)
+    return (res.seg_id, res.lo, res.hi, res.count, res.twin_count,
+            res.first_word, res.last_word, res.nbits)
+
+
+# --- process_segments parity (satellite c) -----------------------------------
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+def test_default_loop_matches_sequential(packing):
+    cfg = SieveConfig(n=100_000, backend="cpu-numpy", packing=packing,
+                      quiet=True)
+    w = CpuNumpyWorker(cfg)
+    seeds = seed_primes(math.isqrt(max(hi for _, hi in SEGMENTS) - 1))
+    sids = [100 + i for i in range(len(SEGMENTS))]
+    batched = w.process_segments(SEGMENTS, seeds, seg_ids=sids)
+    assert len(batched) == len(SEGMENTS)
+    for (lo, hi), sid, res in zip(SEGMENTS, sids, batched):
+        ref = w.process_segment(lo, hi, seeds, seg_id=sid)
+        assert _fields(res) == _fields(ref)
+    # default seg_ids are positional; a length mismatch is a caller bug
+    assert [r.seg_id for r in w.process_segments(SEGMENTS[:2], seeds)] == [0, 1]
+    with pytest.raises(ValueError, match="seg_ids"):
+        w.process_segments(SEGMENTS, seeds, seg_ids=[0])
+
+
+@pytest.mark.parametrize("packing", PACKINGS)
+def test_jax_batch_matches_sequential(packing):
+    pytest.importorskip("jax")
+    from sieve.backends.jax_backend import JaxWorker
+
+    cfg = SieveConfig(n=100_000, backend="jax", packing=packing,
+                      twins=True, quiet=True)
+    w = JaxWorker(cfg)
+    seeds = seed_primes(math.isqrt(max(hi for _, hi in SEGMENTS) - 1))
+    batched = w.process_segments(SEGMENTS, seeds)
+    sequential = [
+        w.process_segment(lo, hi, seeds, seg_id=i)
+        for i, (lo, hi) in enumerate(SEGMENTS)
+    ]
+    for res, ref in zip(batched, sequential):
+        assert _fields(res) == _fields(ref)
+    # and both agree with the numpy reference backend
+    ref_w = CpuNumpyWorker(SieveConfig(
+        n=100_000, backend="cpu-numpy", packing=packing, twins=True,
+        quiet=True,
+    ))
+    for i, (lo, hi) in enumerate(SEGMENTS):
+        assert _fields(batched[i]) == _fields(
+            ref_w.process_segment(lo, hi, seeds, seg_id=i)
+        )
+
+
+# --- burst batching: one dispatch per distinct chunk (satellite c) -----------
+
+
+def test_cold_burst_batches_to_distinct_chunks(ledger_dir):
+    # covered prefix ends at 50_001; cold_chunk 1<<16 puts the grid cut
+    # at 65_536, so the two targets need exactly 3 distinct chunk keys:
+    # (50001, 65536) shared, (65536, 90001), (65536, 120001)
+    settings = _settings(workers=8, queue_limit=32, cold_delay_s=0.25)
+    targets = [90_000, 120_000] * 6  # 12 overlapping cold queries
+    with SieveService(_cfg(str(ledger_dir)), settings) as svc:
+        got, errs = [], []
+
+        def q(x):
+            try:
+                with ServiceClient(svc.addr, timeout_s=30) as c:
+                    got.append((x, c.pi(x)))
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=q, args=(x,)) for x in targets]
+        threads[0].start()
+        time.sleep(0.05)  # inside the first dispatch's simulated compute
+        for t in threads[1:]:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errs
+        assert sorted(got) == sorted((x, o_pi(x)) for x in targets)
+        with ServiceClient(svc.addr) as cli:
+            s = cli.stats()
+        # single-flight + queue-drain batching: 12 queries, ≤ 3 dispatches
+        assert 1 <= s["cold_dispatches"] <= 3
+        assert s["cold_batched_chunks"] <= 3
+        assert s["cold_computes"] <= 3
+
+
+# --- svc_batch_partial: per-chunk degradation (satellite b) ------------------
+
+
+def test_parse_svc_batch_partial():
+    d = parse_chaos("svc_batch_partial:any@s2:1")[0]
+    assert (d.kind, d.worker, d.seg_id, d.param) == (
+        "svc_batch_partial", ANY_WORKER, 2, 1.0
+    )
+    # default param: fail the first chunk of the batch
+    assert parse_chaos("svc_batch_partial:any@s1")[0].param == 0.0
+
+
+def test_svc_batch_partial_degrades_one_chunk(ledger_dir, memsink):
+    with SieveService(_cfg(str(ledger_dir)), _settings()) as svc:
+        k0, k1 = (50_001, 65_536), (65_536, 90_001)
+        with svc._cold_lock:
+            f0 = svc._inflight[k0] = _Flight()
+            f1 = svc._inflight[k1] = _Flight()
+        # key on the NEXT dispatch number; param 0 = first chunk in
+        # sorted batch order
+        svc.inject_chaos(
+            f"svc_batch_partial:any@s{svc.batcher.batches + 1}:0"
+        )
+        svc.batcher._dispatch([k0, k1])
+        assert f0.event.is_set() and isinstance(f0.error, Degraded)
+        assert "svc_batch_partial" in str(f0.error)
+        assert f1.event.is_set() and f1.error is None
+        assert int(f1.result.count) == o_count(65_536, 90_001)
+        assert f1.result.seg_id == COLD_SEG_BASE + 65_536
+        ev = [x for x in memsink.records if x["event"] == "service_batched"]
+        assert len(ev) == 1
+        assert ev[0]["failed"] == 1 and ev[0]["chunks"] == 1
+        for x in ev:
+            validate_record(x)
+
+
+# --- ledger write-back + restart (tentpole acceptance) -----------------------
+
+
+def test_persist_cold_write_back_and_restart(tmp_path):
+    ck = str(tmp_path / "ck")
+    run_local(_cfg(ck))
+    with SieveService(_cfg(ck), _settings(persist_cold=True)) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            assert cli.stats()["persist_cold"] is True
+            assert cli.pi(90_000) == o_pi(90_000)
+            s = cli.stats()
+            assert s["cold_persisted"] == 2  # (50001,65536) + (65536,90001)
+            # never-shrink guard: a clipped recompute of the head chunk
+            # must NOT overwrite the persisted full chunk
+            assert cli.pi(60_000) == o_pi(60_000)
+            assert cli.stats()["cold_persisted"] == 2
+    led = Ledger.open_readonly(_cfg(ck))
+    assert led.recorded_hi(COLD_SEG_BASE + 50_001) == 65_536
+    assert led.recorded_hi(COLD_SEG_BASE + 65_536) == 90_001
+    # restart (no writer): the persisted chunks are hot from the index
+    with SieveService(_cfg(ck), _settings()) as svc2:
+        with ServiceClient(svc2.addr, timeout_s=30) as cli:
+            assert cli.pi(90_000) == o_pi(90_000)
+            s = cli.stats()
+            assert s["covered_hi"] >= 90_001
+            assert s["cold_computes"] == 0 and s["cold_dispatches"] == 0
+            assert s["persist_cold"] is False
+
+
+# --- cold cache is a real LRU now (satellite a) ------------------------------
+
+
+def test_cold_cache_lru_eviction(ledger_dir):
+    # chunk grid 1<<14 from 50_001: (50001,65536) (65536,81920)
+    # (81920,90001) — three chunks through a two-entry cache
+    settings = _settings(cold_chunk=1 << 14, cold_cache_entries=2)
+    with SieveService(_cfg(str(ledger_dir)), settings) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            assert cli.pi(90_000) == o_pi(90_000)
+            s1 = cli.stats()
+            assert list(svc._cold_cache) == [
+                (65_536, 81_920), (81_920, 90_001)
+            ]  # oldest (50001, 65536) evicted
+            # the repeat recomputes ONLY the evicted head chunk; the two
+            # cached tails are hits and get refreshed to most-recent
+            assert cli.pi(90_000) == o_pi(90_000)
+            s2 = cli.stats()
+            assert s2["cold_cache_hits"] - s1["cold_cache_hits"] == 2
+            assert s2["cold_computes"] - s1["cold_computes"] == 1
+            assert list(svc._cold_cache) == [
+                (81_920, 90_001), (50_001, 65_536)
+            ]
+
+
+# --- bench_compare p95 gate (tentpole observability) -------------------------
+
+
+def test_bench_compare_gates_p95_regressions():
+    def rec(v, unit):
+        return {"m": {"metric": "m", "value": v, "unit": unit}}
+
+    # >10% p95 increase fails; a decrease never does
+    _, regs = compare(rec(10.0, "ms_p95"), rec(12.0, "ms_p95"), 0.10)
+    assert regs and "p95" in regs[0]
+    _, regs = compare(rec(10.0, "ms_p95"), rec(10.5, "ms_p95"), 0.10)
+    assert regs == []
+    _, regs = compare(rec(10.0, "ms_p95"), rec(7.0, "ms_p95"), 0.10)
+    assert regs == []
+    # throughput keeps its downward gate: an increase is fine
+    _, regs = compare(
+        rec(100.0, "values/s/chip"), rec(120.0, "values/s/chip"), 0.10
+    )
+    assert regs == []
+    _, regs = compare(
+        rec(100.0, "values/s/chip"), rec(80.0, "values/s/chip"), 0.10
+    )
+    assert regs

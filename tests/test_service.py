@@ -8,7 +8,9 @@ deadline_exceeded / degraded outcomes (no silent hangs, no wrong
 answers); single-flight coalescing; breaker recovery; the service chaos
 grammar; EVENT_SCHEMA validation of the service_* events; rpc.query
 spans rendered by trace_report; the enumerate flags_fn seam; the
-service_smoke tool and the ``serve`` CLI as tier-1 subprocess tests.
+service_smoke tool (including its batched-burst + persisted-restart
+phase, ISSUE 9) and the ``serve`` CLI as tier-1 subprocess tests.
+The batched cold plane's own unit tests live in tests/test_batch.py.
 """
 
 import json

@@ -8,8 +8,10 @@ one JSON metric line per benchmark:
 the last metric re-parsed under ``parsed``. This tool pairs the two
 newest rounds by metric name and prints the delta for each; it exits
 nonzero when any throughput metric (``unit == "values/s/chip"``)
-regressed by more than ``--threshold`` (default 10%), or when the
-newest round itself failed (``rc != 0`` / ``ok == false``).
+regressed by more than ``--threshold`` (default 10%), when any latency
+metric (``unit == "ms_p95"``) *increased* by more than the same
+threshold (lower is better — the service p95 gate, ISSUE 9), or when
+the newest round itself failed (``rc != 0`` / ``ok == false``).
 
 Round order comes from the ``_r<NN>`` filename suffix, NOT mtime — a
 re-checkout or ``touch`` must not reorder history.
@@ -82,12 +84,18 @@ def compare(
         ov, nv = float(o["value"]), float(n["value"])
         delta = (nv - ov) / ov if ov else 0.0
         unit = n.get("unit", "")
-        gated = unit == "values/s/chip"
         verdict = ""
-        if gated and delta < -threshold:
+        if unit == "values/s/chip" and delta < -threshold:
+            # throughput: higher is better, gate on drops
             verdict = f"  REGRESSION (> {threshold:.0%} drop)"
             regressions.append(
                 f"{name}: {ov:.4g} -> {nv:.4g} ({delta:+.1%})"
+            )
+        elif unit == "ms_p95" and delta > threshold:
+            # latency: lower is better, gate on increases
+            verdict = f"  REGRESSION (> {threshold:.0%} p95 increase)"
+            regressions.append(
+                f"{name}: p95 {ov:.4g} ms -> {nv:.4g} ms ({delta:+.1%})"
             )
         lines.append(
             f"  {name}: {ov:.4g} -> {nv:.4g} {unit} "

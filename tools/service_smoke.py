@@ -3,7 +3,7 @@ or typed — never silent, never wrong (ISSUE 7 acceptance; tier-1 via
 tests/test_service.py).
 
 Builds a sieved checkpoint dir, starts a :class:`SieveService` on it,
-and drives real TCP clients through five phases:
+and drives real TCP clients through six phases:
 
 1. correctness sweep — every op (pi / count / nth_prime / primes) hot,
    cold, and straddling the covered boundary, bit-exact against a
@@ -20,6 +20,12 @@ and drives real TCP clients through five phases:
    typed overloaded / deadline_exceeded / degraded error. Health stays
    observable and hot queries stay exact while the backend is down.
 5. recovery — health returns to ok and a cold query is exact again.
+6. batched burst + write-back (ISSUE 9) — a fresh ``--persist-cold``
+   server on the same dir takes 20 concurrent cold queries: every reply
+   oracle-exact, the dispatch counter stays at or below the distinct
+   grid chunks touched (single-digit, not 20), and the results land in
+   the ledger — a restarted server answers the same burst entirely from
+   its index (zero cold computes).
 
 Exit status: 0 on full parity, 1 on any violation (with a FAIL line).
 
@@ -310,6 +316,92 @@ def main(argv: list[str] | None = None) -> int:
               f"cold_computes={s['cold_computes']} "
               f"coalesced={s['coalesced']} shed={s['shed']})", flush=True)
         cli.close()
+        svc.stop()
+
+        # --- phase 6: batched burst + ledger write-back (ISSUE 9) --------
+        # A fresh server with --persist-cold semantics on the SAME dir:
+        # its cold cache is empty, so a 20-thread burst over uncovered
+        # ranges must be answered by the batcher in a handful of backend
+        # dispatches, and the results must be durable in the ledger.
+        settings6 = ServiceSettings(
+            workers=8, queue_limit=32, default_deadline_s=15.0,
+            cold_chunk=1 << 17, cold_delay_s=0.2, refresh_s=0.2,
+            persist_cold=True,
+        )
+        svc = SieveService(cfg, settings6).start()
+        burst = (
+            [("pi", {"x": 390_000}, o_pi(390_000))] * 10
+            + [("pi", {"x": 300_000}, o_pi(300_000))] * 5
+            + [("count", {"lo": 250_000, "hi": 350_000},
+                o_count(250_000, 350_000))] * 5
+        )
+        # distinct grid chunks the burst can touch: targets {250000,
+        # 300001, 350000, 390001} past covered_hi split at the single
+        # 1<<17 grid boundary in range -> 5 distinct (lo, hi) keys
+        max_chunks = 5
+
+        def fire6(i: int, op: str, params: dict, want, out: dict,
+                  lock: threading.Lock) -> None:
+            try:
+                with ServiceClient(svc.addr, timeout_s=30) as c:
+                    rep = c.query(op, **params)
+            except BaseException as e:  # noqa: BLE001
+                rep = {"ok": False, "error": "transport", "detail": repr(e)}
+            with lock:
+                out[i] = (rep, want)
+
+        out6: dict[int, tuple] = {}
+        lock6 = threading.Lock()
+        threads = [
+            threading.Thread(target=fire6, args=(i, op, dict(ps), want,
+                                                 out6, lock6))
+            for i, (op, ps, want) in enumerate(burst)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(40)
+        if any(t.is_alive() for t in threads):
+            fail("batched burst query hung (silent hang)")
+        for i, (rep, want) in sorted(out6.items()):
+            if not rep.get("ok"):
+                fail(f"batched burst query {i}: {rep!r}")
+            expect(f"batched burst query {i}", rep["value"], want)
+        with ServiceClient(svc.addr, timeout_s=10) as c6:
+            s6 = c6.stats()
+        if not (1 <= s6["cold_dispatches"] <= max_chunks):
+            fail(f"burst of {len(burst)} cold queries took "
+                 f"{s6['cold_dispatches']} backend dispatches, want 1.."
+                 f"{max_chunks} (batching not happening)")
+        if s6["cold_batched_chunks"] > max_chunks:
+            fail(f"burst dispatched {s6['cold_batched_chunks']} chunks, "
+                 f"want <= {max_chunks} (single-flight dedup broken)")
+        if s6["cold_persisted"] < 1:
+            fail("persist_cold server wrote nothing back to the ledger")
+        print(f"phase 6a OK: 20-query cold burst -> "
+              f"{s6['cold_dispatches']} dispatches over "
+              f"{s6['cold_batched_chunks']} chunks, "
+              f"{s6['cold_persisted']} persisted", flush=True)
+
+        # restart: a brand-new server on the same dir must answer the
+        # whole burst from its (now extended) index — zero cold computes
+        svc.stop()
+        svc = SieveService(cfg, ServiceSettings(
+            workers=4, queue_limit=32, default_deadline_s=15.0,
+            cold_chunk=1 << 17, cold_delay_s=0.2,
+        )).start()
+        with ServiceClient(svc.addr, timeout_s=30) as c6:
+            for op, ps, want in burst:
+                expect(f"post-restart {op}{ps}",
+                       c6.query(op, **ps).get("value"), want)
+            s6 = c6.stats()
+        if s6["cold_computes"] != 0 or s6["cold_dispatches"] != 0:
+            fail(f"restarted server re-sieved persisted ranges "
+                 f"(cold_computes={s6['cold_computes']}, "
+                 f"cold_dispatches={s6['cold_dispatches']})")
+        print(f"phase 6b OK: restart answered the burst from the "
+              f"persisted index (covered_hi={svc.index.covered_hi}, "
+              f"0 cold computes)", flush=True)
         print("SERVICE_SMOKE_OK", flush=True)
         return 0
     finally:

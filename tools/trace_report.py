@@ -202,6 +202,20 @@ def service_report(spans: list[dict]) -> list[str]:
         accounted += t
         pct = 100 * t / total if total else 0.0
         lines.append(f"    {label:<18} {t / 1e3:>10.3f} ms {pct:>6.1f}%")
+    # batched cold plane (ISSUE 9): query.cold spans nest inside
+    # query.cold_batch, so the batch row reports only the drain/dispatch
+    # overhead on top of the compute already counted above
+    batches = [e for e in spans if e["name"] == "query.cold_batch"]
+    if batches:
+        cold_t = sum(e["dur"] for e in spans if e["name"] == "query.cold")
+        over = max(0.0, sum(e["dur"] for e in batches) - cold_t)
+        accounted += over
+        chunks = sum((e.get("args") or {}).get("chunks", 0) for e in batches)
+        lines.append(
+            f"    {'cold batch':<18} {over / 1e3:>10.3f} ms "
+            f"{100 * over / total if total else 0:>6.1f}%"
+            f"  ({len(batches)} dispatches, {chunks} chunks)"
+        )
     other = max(0.0, total - accounted)
     lines.append(
         f"    {'index/other':<18} {other / 1e3:>10.3f} ms "
