@@ -360,12 +360,106 @@ def service_latency_metric() -> None:
     )
 
 
+def service_hot_under_flood_metric() -> None:
+    """Priority-lane metric (ISSUE 10): hot-query p95 while a 20-thread
+    cold flood saturates the backend plane (``cold_delay_s`` simulated).
+    Gated by tools/bench_compare.py's ``ms_p95`` rule: the number must
+    not regress >10% round-over-round — the lane isolation guarantee as
+    a benchmark. Every hot reply is asserted exact; cold replies must be
+    exact or typed."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from sieve import trace
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+    from sieve.seed import seed_primes
+    from sieve.service import ServiceClient, ServiceSettings, SieveService
+
+    n = 2_000_000
+    chunk = 1 << 18
+    oracle = seed_primes(n + 24 * chunk)
+
+    def o_pi(x: int) -> int:
+        return int(np.searchsorted(oracle, x, side="right"))
+
+    with tempfile.TemporaryDirectory(prefix="sieve_bench_flood") as ck:
+        cfg = SieveConfig(
+            n=n, backend="cpu-numpy", packing="odds", n_segments=8,
+            checkpoint_dir=ck, quiet=True,
+        )
+        run_local(cfg)
+        trace.enable()
+        trace.drain_events()
+        settings = ServiceSettings(
+            workers=4, hot_workers=1, queue_limit=64, cold_queue_limit=16,
+            cold_chunk=chunk, cold_delay_s=0.15, cold_age_s=0.5,
+            default_deadline_s=30.0, refresh_s=0.0,
+        )
+        typed = {"overloaded", "deadline_exceeded", "degraded"}
+        with SieveService(cfg, settings) as svc, \
+                ServiceClient(svc.addr, timeout_s=60) as cli:
+            for i in range(50):  # warm the hot path / LRU first
+                x = (7919 * (i + 1)) % n
+                assert cli.pi(x) == o_pi(x), f"warm pi({x}) parity failure"
+
+            def flood(i: int) -> None:
+                x = n + (i + 1) * chunk - 1  # distinct cold chunks
+                with ServiceClient(svc.addr, timeout_s=60) as c:
+                    rep = c.query("pi", x=x)
+                    if rep.get("ok"):
+                        assert rep["value"] == o_pi(x), \
+                            f"cold pi({x}) parity failure"
+                    else:
+                        assert rep.get("error") in typed, \
+                            f"cold pi({x}) untyped reply {rep!r}"
+
+            threads = [threading.Thread(target=flood, args=(i,))
+                       for i in range(20)]
+            t_mark = trace.now_s()
+            for t in threads:
+                t.start()
+            for _ in range(3):  # the hot stream the lanes must protect
+                for i in range(50):
+                    x = (7919 * (i + 1)) % n
+                    assert cli.pi(x) == o_pi(x), \
+                        f"hot pi({x}) parity failure"
+            for t in threads:
+                t.join(120)
+        events, _dropped = trace.drain_events()
+        trace.disable()
+    hot_ms = [
+        e["dur"] / 1000.0 for e in events
+        if e.get("name") == "rpc.query"
+        and (e.get("args") or {}).get("lane") == "hot"
+        and e["ts"] / 1e6 >= t_mark  # flood window only, not the warmup
+    ]
+    assert hot_ms, "no hot-lane rpc.query spans captured under flood"
+    p95 = _pctile(hot_ms, 0.95)
+    budget_ms = 50.0
+    print(
+        json.dumps(
+            {
+                "metric": "service_hot_under_flood_ms_p95",
+                "value": round(p95, 3),
+                "unit": "ms_p95",
+                "vs_baseline": round(budget_ms / p95, 3) if p95 else None,
+                "p50_ms": round(_pctile(hot_ms, 0.5), 3),
+                "hot_n": len(hot_ms),
+            }
+        )
+    )
+
+
 def main() -> int:
     shallow_metric()
     depth_metric()
     host_prepare_metric()
     fused_reduction_metric()
     service_latency_metric()
+    service_hot_under_flood_metric()
     return 0
 
 

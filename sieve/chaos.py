@@ -68,6 +68,19 @@ counter, like the follower draws refresh attempts):
                               exact — the batch path must degrade
                               per-chunk, never per-batch.
 
+Priority lanes (ISSUE 10; drawn by the dispatcher on the request
+sequence number like the other ``svc_*`` request kinds):
+
+* ``svc_flood:any@sK:lane``   request K is refused admission as if the
+                              named lane (``hot`` or ``cold``; default
+                              ``cold``) were at capacity: a typed
+                              ``overloaded`` reply carrying the lane, a
+                              ``service_lane_shed`` event, and — for a
+                              cold-lane shed — a ReplicaSet failover,
+                              all without needing a real flood. The
+                              only kind whose param is a lane name, not
+                              seconds.
+
 ``worker`` is an integer id, or ``any``/``*`` for whichever worker draws
 the segment (the pull model makes a specific id probabilistic, ``any``
 deterministic). Directives are transported to the worker inside the
@@ -99,6 +112,7 @@ KINDS = (
     "replica_down",
     "svc_drain",
     "svc_batch_partial",
+    "svc_flood",
 )
 # kinds handled by the query service (sieve/service/); the cluster plane
 # ignores these and vice versa. Request-scoped kinds key on the request
@@ -114,6 +128,7 @@ SERVICE_KINDS = (
     "replica_down",
     "svc_drain",
     "svc_batch_partial",
+    "svc_flood",
 )
 SERVICE_REQUEST_KINDS = (
     "svc_stall",
@@ -121,9 +136,14 @@ SERVICE_REQUEST_KINDS = (
     "backend_down",
     "replica_down",
     "svc_drain",
+    "svc_flood",
 )
-# default param (seconds) for kinds that take one; None = no param
-DEFAULT_PARAM: dict[str, float | None] = {
+# kinds whose param is a LANE NAME ("hot"/"cold"), not seconds
+LANE_PARAM_KINDS = ("svc_flood",)
+_LANES = ("hot", "cold")
+# default param (seconds, or a lane name) for kinds that take one;
+# None = no param
+DEFAULT_PARAM: dict[str, float | str | None] = {
     "kill": None,
     "stall": 1.0,
     "drop_hb": None,
@@ -136,6 +156,8 @@ DEFAULT_PARAM: dict[str, float | None] = {
     "svc_drain": None,
     # param = 0-based index of the chunk to fail, in sorted batch order
     "svc_batch_partial": 0.0,
+    # param = the lane to refuse admission on
+    "svc_flood": "cold",
 }
 
 
@@ -144,7 +166,7 @@ class ChaosDirective:
     kind: str
     worker: int  # ANY_WORKER matches every worker
     seg_id: int
-    param: float | None = None
+    param: float | str | None = None
 
     def matches(self, worker_id: int, seg_id: int) -> bool:
         return self.seg_id == seg_id and self.worker in (ANY_WORKER, worker_id)
@@ -201,14 +223,25 @@ def parse_chaos(spec: str) -> list[ChaosDirective]:
         if len(parts) == 3:
             if DEFAULT_PARAM[kind] is None:
                 raise ValueError(f"chaos item {item!r}: {kind} takes no param")
-            try:
-                param = float(parts[2])
-            except ValueError:
-                raise ValueError(
-                    f"chaos item {item!r}: param must be a number (seconds)"
-                ) from None
-            if param < 0:
-                raise ValueError(f"chaos item {item!r}: param must be >= 0")
+            if kind in LANE_PARAM_KINDS:
+                param = parts[2]
+                if param not in _LANES:
+                    raise ValueError(
+                        f"chaos item {item!r}: param must be a lane "
+                        f"({' or '.join(_LANES)}), got {param!r}"
+                    )
+            else:
+                try:
+                    param = float(parts[2])
+                except ValueError:
+                    raise ValueError(
+                        f"chaos item {item!r}: param must be a number "
+                        "(seconds)"
+                    ) from None
+                if param < 0:
+                    raise ValueError(
+                        f"chaos item {item!r}: param must be >= 0"
+                    )
         else:
             param = DEFAULT_PARAM[kind]
         out.append(ChaosDirective(kind, worker, seg_id, param))

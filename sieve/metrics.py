@@ -89,6 +89,13 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     # written back to the ledger (0 unless --persist-cold), "failed" how
     # many chunks were chaos-failed out of the batch pre-dispatch.
     "service_batched": {"chunks", "lo", "hi", "ms", "persisted", "failed"},
+    # priority lanes (ISSUE 10): service_lane_shed marks a per-lane
+    # admission refusal (queue_depth is THAT lane's depth; a lane shed
+    # also emits the lane-less service_shed for continuity);
+    # service_demoted marks a misclassified hot request re-enqueued on
+    # the cold lane ("chunks" = how many chunks needed a dispatch).
+    "service_lane_shed": {"op", "lane", "queue_depth"},
+    "service_demoted": {"op", "chunks"},
 }
 
 

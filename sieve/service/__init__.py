@@ -30,6 +30,15 @@ backend dispatch (`SieveWorker.process_segments`; a single vmapped
 device launch on jax), and ``--persist-cold`` writes the results back
 into the ledger so ``covered_hi`` grows under read traffic and
 restarts/replicas answer yesterday's cold ranges from the index.
+
+Priority lanes (ISSUE 10): admission splits into two bounded lanes —
+**hot** (fully answerable from the index + caches) and **cold** (may
+need a backend dispatch) — with a worker reserved for hot whenever
+``workers > 1``, cold-lane aging so cold is delayed but never starved,
+brownout (under hot backlog the cold lane sheds first), and demotion
+(a hot query that discovers a cold chunk mid-execution hands off to
+the cold lane). Typed ``overloaded`` sheds carry the lane; the
+``svc_flood`` chaos kind injects them deterministically.
 """
 
 from sieve.service.client import (

@@ -57,6 +57,11 @@ class QueryCtx:
     # progress for typed partial answers (prefix [2, answered_hi) done)
     answered_hi: int = 2
     count_so_far: int = 0
+    # admission lane (ISSUE 10): "hot" requests demote to the cold lane
+    # when they discover a chunk needing a backend dispatch; "cold" (the
+    # default) never demotes, so contexts built outside the server's
+    # admission path are unaffected
+    lane: str = "cold"
 
     def tick(self) -> None:
         if self.check is not None:

@@ -131,6 +131,19 @@ class Draining(Exception):
     """Server is draining (SIGTERM / shutdown): new queries are shed."""
 
 
+class _Demoted(Exception):
+    """Internal lane signal, never wire-visible (ISSUE 10): a hot-lane
+    request discovered chunks needing a backend dispatch mid-execution.
+    The handler re-enqueues the whole request on the cold lane instead
+    of holding a hot worker through the dispatch — the registered
+    flights are already submitted to the batcher, so the cold re-run
+    joins them as a follower (or finds the results cached)."""
+
+    def __init__(self, chunks: int):
+        super().__init__(f"demoted to cold lane ({chunks} cold chunk(s))")
+        self.chunks = chunks
+
+
 _ERROR_KIND = {
     Overloaded: "overloaded",
     DeadlineExceeded: "deadline_exceeded",
@@ -140,12 +153,28 @@ _ERROR_KIND = {
 }
 
 
-def _env_int(name: str, default: int) -> int:
-    return int(os.environ.get(name, default))
+def _env_int(name: str, default: int | None) -> int | None:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"env {name}={raw!r}: expected an integer"
+        ) from None
 
 
 def _env_float(name: str, default: float) -> float:
-    return float(os.environ.get(name, default))
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"env {name}={raw!r}: expected a number"
+        ) from None
 
 
 @dataclasses.dataclass
@@ -179,6 +208,62 @@ class ServiceSettings:
     # writer), and cap how many chunks one backend dispatch may carry
     persist_cold: bool = False
     batch_max_chunks: int = 128
+    # priority lanes (ISSUE 10): per-lane queue limits (None inherits
+    # queue_limit), dedicated hot workers (capped at workers-1 so the
+    # cold plane always keeps at least one worker when workers > 1),
+    # and the age at which a queued cold item beats fresh hot work
+    hot_queue_limit: int | None = None
+    cold_queue_limit: int | None = None
+    hot_workers: int = 1
+    cold_age_s: float = 1.0
+
+    def validate(self) -> "ServiceSettings":
+        """Typed startup validation: every rejection names the setting
+        (and, via ``from_env``, parse failures name the env variable) —
+        a bad knob must fail at startup, never as undefined runtime
+        behavior in the admission plane."""
+        for name in ("queue_limit", "workers", "batch_max_chunks",
+                     "lru_segments", "cold_chunk", "cold_cache_entries",
+                     "max_primes", "max_pair_span", "breaker_fails"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                raise ValueError(
+                    f"service settings: {name}={v!r} must be a positive "
+                    "integer"
+                )
+        for name in ("hot_queue_limit", "cold_queue_limit"):
+            v = getattr(self, name)
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v <= 0):
+                raise ValueError(
+                    f"service settings: {name}={v!r} must be a positive "
+                    "integer (or None to inherit queue_limit)"
+                )
+        if (not isinstance(self.hot_workers, int)
+                or isinstance(self.hot_workers, bool)
+                or self.hot_workers < 0):
+            raise ValueError(
+                f"service settings: hot_workers={self.hot_workers!r} "
+                "must be a non-negative integer"
+            )
+        for name in ("refresh_s", "drain_s", "cold_delay_s", "cold_age_s",
+                     "breaker_cooldown_s"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0 or not math.isfinite(v):
+                raise ValueError(
+                    f"service settings: {name}={v!r} must be a "
+                    "non-negative number"
+                )
+        if (not isinstance(self.default_deadline_s, (int, float))
+                or isinstance(self.default_deadline_s, bool)
+                or self.default_deadline_s <= 0
+                or not math.isfinite(self.default_deadline_s)):
+            raise ValueError(
+                "service settings: default_deadline_s="
+                f"{self.default_deadline_s!r} must be a positive number"
+            )
+        return self
 
     @classmethod
     def from_env(cls, **overrides: Any) -> "ServiceSettings":
@@ -211,6 +296,14 @@ class ServiceSettings:
             batch_max_chunks=_env_int(
                 "SIEVE_SVC_BATCH_MAX", cls.batch_max_chunks
             ),
+            hot_queue_limit=_env_int(
+                "SIEVE_SVC_HOT_QUEUE", cls.hot_queue_limit
+            ),
+            cold_queue_limit=_env_int(
+                "SIEVE_SVC_COLD_QUEUE", cls.cold_queue_limit
+            ),
+            hot_workers=_env_int("SIEVE_SVC_HOT_WORKERS", cls.hot_workers),
+            cold_age_s=_env_float("SIEVE_SVC_COLD_AGE_S", cls.cold_age_s),
         )
         return dataclasses.replace(s, **overrides)
 
@@ -630,6 +723,11 @@ _STATS = (
     "cold_persisted",
     "coalesced",
     "shed",
+    "hot_admitted",
+    "cold_admitted",
+    "demoted",
+    "lane_shed_hot",
+    "lane_shed_cold",
     "deadline_exceeded",
     "degraded_replies",
     "draining_replies",
@@ -648,7 +746,7 @@ class SieveService:
         addr: str | None = None,
     ):
         self.config = config
-        self.settings = settings or ServiceSettings.from_env()
+        self.settings = (settings or ServiceSettings.from_env()).validate()
         self._addr_req = addr or "127.0.0.1:0"
         self.metrics = MetricsLogger(config)
         entries = {}
@@ -680,7 +778,25 @@ class SieveService:
         self._writer: Ledger | None = None
         if self.settings.persist_cold and config.checkpoint_dir:
             self._writer = Ledger.open(config)
-        self._queue: "queue.Queue" = queue.Queue(self.settings.queue_limit)
+        # priority lanes (ISSUE 10): two bounded deques under one
+        # condition. Dedicated hot workers only ever pull "hot"; shared
+        # workers prefer hot unless the cold head has aged past
+        # cold_age_s (cold is delayed, never starved). workers == 1
+        # degenerates to a single shared hot-preferring worker — a
+        # reservation would otherwise starve the cold lane outright.
+        s = self.settings
+        self._hot_limit = (s.hot_queue_limit if s.hot_queue_limit is not None
+                           else s.queue_limit)
+        self._cold_limit = (s.cold_queue_limit
+                            if s.cold_queue_limit is not None
+                            else s.queue_limit)
+        self._dedicated_hot = (min(s.hot_workers, s.workers - 1)
+                               if s.workers > 1 else 0)
+        self._lanes: dict[str, collections.deque] = {
+            "hot": collections.deque(), "cold": collections.deque(),
+        }
+        self._lane_cond = threading.Condition()
+        self._stopping = False
         self._seq = 0
         self._seq_lock = threading.Lock()
         self._stats = {k: 0 for k in _STATS}
@@ -741,8 +857,11 @@ class SieveService:
         t.start()
         self._threads.append(t)
         for i in range(self.settings.workers):
-            w = threading.Thread(target=self._worker_loop, daemon=True,
-                                 name=f"svc-worker-{i}")
+            dedicated = i < self._dedicated_hot
+            w = threading.Thread(
+                target=self._worker_loop, args=(dedicated,), daemon=True,
+                name=f"svc-worker-{'hot-' if dedicated else ''}{i}",
+            )
             w.start()
             self._threads.append(w)
         self.batcher.start()
@@ -773,7 +892,8 @@ class SieveService:
                 self._listener.close()
             except OSError:
                 pass
-        self.metrics.event("service_drain", queued=self._queue.qsize(),
+        hot, cold = self._lane_depths()
+        self.metrics.event("service_drain", queued=hot + cold,
                            inflight=self._inflight_n)
         registry().gauge("service.draining").set(1.0)
         self.drain_event.set()
@@ -799,11 +919,9 @@ class SieveService:
                 self._listener.close()
             except OSError:
                 pass
-        for _ in range(self.settings.workers):
-            try:
-                self._queue.put_nowait(None)
-            except queue.Full:
-                break
+        with self._lane_cond:
+            self._stopping = True
+            self._lane_cond.notify_all()
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
@@ -830,11 +948,93 @@ class SieveService:
             self._stats[name] += n
         registry().counter(f"service.{name}").inc(n)
 
+    # --- lanes (ISSUE 10) -------------------------------------------------
+
+    def _lane_depths(self) -> tuple[int, int]:
+        with self._lane_cond:
+            return len(self._lanes["hot"]), len(self._lanes["cold"])
+
+    def _brownout_locked(self) -> bool:
+        # brownout: the hot lane is backlogged past half its limit —
+        # sustained overload where the cold lane must shed first so hot
+        # answers stay exact
+        return len(self._lanes["hot"]) >= max(1, self._hot_limit // 2)
+
+    def brownout(self) -> bool:
+        with self._lane_cond:
+            return self._brownout_locked()
+
+    def _lane_limit_locked(self, lane: str) -> int:
+        if lane == "hot":
+            return self._hot_limit
+        if self._brownout_locked():
+            return max(1, self._cold_limit // 2)
+        return self._cold_limit
+
+    def _set_depth_gauges(self, hot: int, cold: int) -> None:
+        reg = registry()
+        reg.gauge("service.queue_depth").set(float(hot + cold))
+        reg.gauge("service.queue_depth.hot").set(float(hot))
+        reg.gauge("service.queue_depth.cold").set(float(cold))
+
+    def _lane_put(self, lane: str, item: tuple) -> bool:
+        """Bounded per-lane admission; False means the caller must shed
+        typed ``overloaded`` (the cold limit halves under brownout)."""
+        with self._lane_cond:
+            if self._stopping:
+                return False
+            q = self._lanes[lane]
+            if len(q) >= self._lane_limit_locked(lane):
+                return False
+            q.append(item)
+            hot = len(self._lanes["hot"])
+            cold = len(self._lanes["cold"])
+            self._lane_cond.notify_all()
+        self._set_depth_gauges(hot, cold)
+        return True
+
+    def _next_item(self, dedicated: bool):
+        """Pull the next request for one worker. Dedicated workers serve
+        only the hot lane (the reservation that keeps ColdBatcher floods
+        out of the whole pool); shared workers prefer hot unless the
+        cold head has waited >= cold_age_s — an aged cold item beats
+        fresh hot work, so cold is delayed, never starved."""
+        with self._lane_cond:
+            while True:
+                hot = self._lanes["hot"]
+                cold = self._lanes["cold"]
+                item = None
+                if dedicated:
+                    if hot:
+                        item = hot.popleft()
+                elif hot and cold:
+                    aged = (trace.now_s() - cold[0][2]
+                            >= self.settings.cold_age_s)
+                    item = cold.popleft() if aged else hot.popleft()
+                elif hot:
+                    item = hot.popleft()
+                elif cold:
+                    item = cold.popleft()
+                if item is not None:
+                    h, c = len(hot), len(cold)
+                    self._set_depth_gauges(h, c)
+                    return item
+                if self._stopping:
+                    return None
+                # timed wait: an aging cold head must be re-examined even
+                # if no new put ever notifies
+                self._lane_cond.wait(0.05)
+
     def stats(self) -> dict:
         with self._stats_lock:
             out = dict(self._stats)
         out.update(self.index.stats())
-        out["queue_depth"] = self._queue.qsize()
+        hot, cold = self._lane_depths()
+        out["queue_depth"] = hot + cold
+        out["queue_depth_hot"] = hot
+        out["queue_depth_cold"] = cold
+        out["brownout"] = self.brownout()
+        out["hot_workers_dedicated"] = self._dedicated_hot
         out["degraded"] = self.cold.degraded
         out["refreshes"] = self._refreshes
         out["refresh_failed"] = self._refresh_failed
@@ -910,12 +1110,16 @@ class SieveService:
         if mtype == "health":
             # answered inline by the reader: health must stay observable
             # under full-queue shed pressure and a dead backend alike
+            hot, cold = self._lane_depths()
             self._reply(conn, send_lock, {
                 "type": "health", "id": rid, "ok": True,
                 "status": "degraded" if self.cold.degraded else "ok",
                 "covered_hi": idx.covered_hi,
                 "total_primes": idx.total_primes,
-                "queue_depth": self._queue.qsize(),
+                "queue_depth": hot + cold,
+                "queue_depth_hot": hot,
+                "queue_depth_cold": cold,
+                "brownout": self.brownout(),
                 "snapshot_age_s": round(
                     trace.now_s() - self._snapshot_ts, 3
                 ),
@@ -965,6 +1169,22 @@ class SieveService:
                          "error": "bad_request",
                          "detail": f"unknown message type {mtype!r}"})
             return None
+        dl = msg.get("deadline_s")
+        if dl is not None and (
+            not isinstance(dl, (int, float)) or isinstance(dl, bool)
+            or dl <= 0 or not math.isfinite(dl)
+        ):
+            # a malformed deadline is the CLIENT's bug: reply typed
+            # bad_request instead of manufacturing an already-expired
+            # deadline and calling it deadline_exceeded
+            self._bump("bad_requests")
+            self._reply(conn, send_lock, {
+                "type": "reply", "id": rid, "ok": False,
+                "op": str(msg.get("op", "")), "error": "bad_request",
+                "detail": f"deadline_s must be a positive number, "
+                          f"got {dl!r}",
+            })
+            return None
         with self._seq_lock:
             self._seq += 1
             seq = self._seq
@@ -982,10 +1202,23 @@ class SieveService:
         if any(d["kind"] == "svc_shed" for d in directives):
             self._shed(conn, send_lock, rid, op, forced=True)
             return None
+        flood = next(
+            (d for d in directives if d["kind"] == "svc_flood"), None
+        )
+        if flood is not None:
+            # svc_flood:any@sK:<lane> — request K is refused as if the
+            # named lane were at capacity: the deterministic injection of
+            # the lane-shed surface (reply lane field, service_lane_shed
+            # event, ReplicaSet failover) without a real 20-thread flood
+            self._shed(conn, send_lock, rid, op, forced=True,
+                       lane=str(flood["param"] or "cold"),
+                       chaos_kind="svc_flood")
+            return None
         if self._draining:
+            hot, cold = self._lane_depths()
             self._bump("draining_replies")
             self.metrics.event("service_shed", quietable=True, op=op,
-                               queue_depth=self._queue.qsize(),
+                               queue_depth=hot + cold,
                                reason="draining")
             self._reply(conn, send_lock, {
                 "type": "reply", "id": rid, "ok": False, "op": op,
@@ -994,65 +1227,153 @@ class SieveService:
                           "on another replica",
             })
             return None
+        lane = self._classify(msg, idx)
         item = (msg, rid if rid is not None else seq, trace.now_s(),
-                directives, idx, conn, send_lock)
+                directives, idx, conn, send_lock, lane, False)
         with self._inflight_lock:
             self._inflight_n += 1
-        try:
-            self._queue.put_nowait(item)
-        except queue.Full:
+        if not self._lane_put(lane, item):
             with self._inflight_lock:
                 self._inflight_n -= 1
-            self._shed(conn, send_lock, rid, op, forced=False)
+            self._shed(conn, send_lock, rid, op, forced=False, lane=lane)
             return None
-        registry().gauge("service.queue_depth").set(self._queue.qsize())
+        self._bump(f"{lane}_admitted")
         return None
 
-    def _shed(self, conn, send_lock, rid, op: str, forced: bool) -> None:
-        depth = self._queue.qsize()
+    def _shed(self, conn, send_lock, rid, op: str, forced: bool,
+              lane: str | None = None, chaos_kind: str = "svc_shed") -> None:
+        hot, cold = self._lane_depths()
+        depth = hot + cold
         self._bump("shed")
         self.metrics.event("service_shed", quietable=True, op=op,
                            queue_depth=depth)
-        detail = (
-            "shed by injected svc_shed fault" if forced
-            else f"admission queue full ({depth}/{self.settings.queue_limit})"
-        )
-        self._reply(conn, send_lock, {
+        if lane is not None:
+            self._bump(f"lane_shed_{lane}")
+            self.metrics.event(
+                "service_lane_shed", quietable=True, op=op, lane=lane,
+                queue_depth=hot if lane == "hot" else cold,
+            )
+        if forced and lane is not None:
+            detail = (f"shed by injected {chaos_kind} fault "
+                      f"({lane} lane at capacity)")
+        elif forced:
+            detail = "shed by injected svc_shed fault"
+        else:
+            with self._lane_cond:
+                limit = self._lane_limit_locked(lane)
+            d = hot if lane == "hot" else cold
+            detail = f"admission queue full: {lane} lane ({d}/{limit})"
+            if lane == "cold" and limit < self._cold_limit:
+                detail += " [brownout: cold limit halved]"
+        reply = {
             "type": "reply", "id": rid, "ok": False, "op": op,
             "error": "overloaded", "detail": detail,
-        })
+        }
+        if lane is not None:
+            reply["lane"] = lane
+        self._reply(conn, send_lock, reply)
 
     # --- request handling ------------------------------------------------
 
-    def _worker_loop(self) -> None:
+    def _classify(self, msg: dict, idx: SieveIndex) -> str:
+        """Lane classification at enqueue (ISSUE 10): **hot** iff the
+        query is fully answerable from SieveIndex + the caches — hi
+        within covered_hi (every slice is index-materializable), or
+        every grid chunk past covered already sitting in the cold
+        cache. Anything that may need a backend dispatch is **cold**.
+        Malformed queries classify hot: a typed bad_request is cheap
+        and must never queue behind a cold flood."""
+        op = msg.get("op")
+        try:
+            if op == "pi":
+                return self._lane_for_prefixes([int(msg["x"]) + 1], idx)
+            if op == "count":
+                lo, hi = int(msg["lo"]), int(msg["hi"])
+                if hi < lo or hi > MAX_HI:
+                    return "hot"  # typed bad_request
+                if str(msg.get("kind", "primes")) == "primes":
+                    return self._lane_for_prefixes([lo, hi], idx)
+                # pair kinds enumerate: hot only within the index
+                return "hot" if hi <= idx.covered_hi else "cold"
+            if op == "nth_prime":
+                return ("hot" if int(msg["k"]) <= idx.total_primes
+                        else "cold")
+            if op == "primes":
+                lo, hi = int(msg["lo"]), int(msg["hi"])
+                if hi < lo or hi > MAX_HI:
+                    return "hot"
+                return "hot" if hi <= idx.covered_hi else "cold"
+        except (KeyError, TypeError, ValueError):
+            return "hot"  # malformed → typed bad_request, cheap
+        return "hot"  # unknown op → typed bad_request
+
+    def _lane_for_prefixes(self, vs: list[int], idx: SieveIndex) -> str:
+        keys: set[tuple[int, int]] = set()
+        for v in vs:
+            if v > MAX_HI:
+                return "hot"  # typed bad_request
+            keys.update(self._grid_chunks(min(v, idx.covered_hi), v))
+        if not keys:
+            return "hot"
+        if len(keys) > 32:
+            return "cold"  # too many chunks to probe the cache for
+        with self._cold_lock:
+            return ("hot" if all(k in self._cold_cache for k in keys)
+                    else "cold")
+
+    def _grid_chunks(self, covered: int, v: int) -> list[tuple[int, int]]:
+        """The cold chunk list [covered, v) on the fixed grid — shared
+        by classification and _count_upto so they can never disagree."""
+        chunks: list[tuple[int, int]] = []
+        a = covered
+        while a < v:
+            b = min(_grid_next(a, self.settings.cold_chunk), v)
+            chunks.append((a, b))
+            a = b
+        return chunks
+
+    def _worker_loop(self, dedicated: bool = False) -> None:
         while True:
-            item = self._queue.get()
+            item = self._next_item(dedicated)
             if item is None:
                 return
-            registry().gauge("service.queue_depth").set(self._queue.qsize())
             try:
                 self._handle(*item)
             except Exception:
                 pass  # _handle replies "internal" itself; never die
 
+    def _requeue_cold(self, msg, rid, enq_t, idx, conn, send_lock) -> bool:
+        """Demotion (ISSUE 10): re-enqueue a misclassified hot request on
+        the cold lane. The original enq_t rides along, so its deadline
+        keeps draining and cold-lane aging sees its true wait."""
+        item = (msg, rid, enq_t, (), idx, conn, send_lock, "cold", True)
+        return self._lane_put("cold", item)
+
     def _handle(self, msg, rid, enq_t, directives, idx,
-                conn, send_lock) -> None:
+                conn, send_lock, lane: str = "cold",
+                demoted: bool = False) -> None:
         # ``idx`` is the snapshot captured at admission: the whole request
         # runs on it even if the follower swaps self.index mid-flight
         op = str(msg.get("op", ""))
         t_pop = trace.now_s()
-        trace.add_span("query.queue_wait", enq_t, t_pop - enq_t, op=op)
+        trace.add_span("query.queue_wait", enq_t, t_pop - enq_t, op=op,
+                       lane=lane)
+        registry().histogram(f"service.queue_wait_ms.{lane}").observe(
+            (t_pop - enq_t) * 1000.0
+        )
         deadline = enq_t + float(
             msg.get("deadline_s") or self.settings.default_deadline_s
         )
         ctx = QueryCtx()
+        ctx.lane = lane
 
         def check() -> None:
             if trace.now_s() > deadline:
                 raise DeadlineExceeded(ctx.answered_hi, ctx.count_so_far)
 
         ctx.check = check
-        self._bump("requests")
+        if not demoted:  # a demoted re-run is the SAME request
+            self._bump("requests")
         outcome = "ok"
         reply: dict = {"type": "reply", "id": rid, "ok": True, "op": op}
         try:
@@ -1064,6 +1385,28 @@ class SieveService:
                                          "chaos backend_down")
             check()
             reply["value"] = self._execute(op, msg, ctx, deadline, idx)
+        except _Demoted as e:
+            if self._requeue_cold(msg, rid, enq_t, idx, conn, send_lock):
+                self._bump("demoted")
+                self.metrics.event("service_demoted", quietable=True,
+                                   op=op, chunks=e.chunks)
+                # no reply, no inflight decrement: the cold re-run of
+                # this same request owns both now
+                return
+            # cold lane refused the demotion: typed lane shed
+            outcome = "overloaded"
+            _h, c = self._lane_depths()
+            self._bump("shed")
+            self._bump("lane_shed_cold")
+            self.metrics.event("service_lane_shed", quietable=True, op=op,
+                               lane="cold", queue_depth=c)
+            reply = {
+                "type": "reply", "id": rid, "ok": False, "op": op,
+                "error": "overloaded", "lane": "cold",
+                "detail": "cold lane full while demoting a misclassified "
+                          "hot query; retry",
+                "partial": None,
+            }
         except tuple(_ERROR_KIND) as e:
             outcome = _ERROR_KIND[type(e)]
             reply = {
@@ -1083,7 +1426,7 @@ class SieveService:
         reply.setdefault("source", source)
         reply["elapsed_ms"] = round((t_end - enq_t) * 1000, 3)
         trace.add_span("rpc.query", enq_t, t_end - enq_t, op=op,
-                       outcome=outcome, source=source)
+                       outcome=outcome, source=source, lane=lane)
         # counters/events before the reply: a stats call racing the
         # reply must already see this request accounted for
         if outcome == "ok" and not ctx.cold and not ctx.materialized:
@@ -1155,12 +1498,7 @@ class SieveService:
         total = idx.count_upto(covered, ctx)
         if covered >= v:
             return total
-        chunks: list[tuple[int, int]] = []
-        a = covered
-        while a < v:
-            b = min(_grid_next(a, self.settings.cold_chunk), v)
-            chunks.append((a, b))
-            a = b
+        chunks = self._grid_chunks(covered, v)
         return total + self._cold_counts(chunks, ctx, deadline, base=total)
 
     def _count(self, lo: int, hi: int, kind: str,
@@ -1310,6 +1648,17 @@ class SieveService:
                     plan.append((key, None, flight, False))
                 else:
                     plan.append((key, None, flight, True))
+        if submit:
+            ctx.cold = True
+            self.batcher.submit(submit)
+        if ctx.lane == "hot" and any(res is None for _k, res, _f, _fl in plan):
+            # misclassified hot query (ISSUE 10): the chunks are already
+            # handed to the cold plane (leaders submitted above, flights
+            # registered); demote instead of parking a hot worker on a
+            # backend dispatch. The cold re-run waits as a follower —
+            # its tier bumps happen then, so nothing double-counts.
+            raise _Demoted(sum(1 for _k, res, _f, _fl in plan
+                               if res is None))
         for key, res, _f, follower in plan:
             if res is not None:
                 ctx.cold_cached = True
@@ -1318,9 +1667,6 @@ class SieveService:
                 self._bump("coalesced")
                 self.metrics.event("service_coalesced", quietable=True,
                                    op="count_range", lo=key[0], hi=key[1])
-        if submit:
-            ctx.cold = True
-            self.batcher.submit(submit)
         total = 0
         for key, res, flight, _follower in plan:
             ctx.tick()

@@ -1,0 +1,374 @@
+"""Priority-lane admission: hot queries survive cold floods (ISSUE 10).
+
+Covers: ServiceSettings.validate() naming the bad setting at startup and
+``_env_int``/``_env_float`` naming the env variable on parse failure;
+malformed ``deadline_s`` as a typed ``bad_request`` (client side); a
+snapshot of the health/stats reply key schema including the per-lane
+fields; hot/cold classification (index-covered, cold-cache-covered,
+malformed); cold-lane sheds carrying ``lane`` while concurrent hot
+queries keep answering; brownout halving the cold limit; misclassified
+hot queries demoting to the cold lane end to end; the ``svc_flood``
+chaos grammar, its injection, and ReplicaSet failover on the resulting
+typed ``overloaded``; EVENT_SCHEMA validation of the lane events; and
+trace_report's per-lane rows.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from sieve import metrics, trace
+from sieve.chaos import parse_chaos
+from sieve.config import SieveConfig
+from sieve.coordinator import run_local
+from sieve.metrics import MemorySink, validate_record
+from sieve.seed import seed_primes
+from sieve.service import (
+    ReplicaSet,
+    ServiceClient,
+    ServiceSettings,
+    SieveService,
+)
+
+N = 50_000
+P = seed_primes(400_000)
+
+
+def o_pi(x):
+    return int(np.searchsorted(P, x, side="right"))
+
+
+@pytest.fixture
+def memsink():
+    sink = MemorySink()
+    metrics.add_sink(sink)
+    yield sink
+    metrics.remove_sink(sink)
+
+
+@pytest.fixture(scope="module")
+def ledger_dir(tmp_path_factory):
+    path = tmp_path_factory.mktemp("lanes_ledger")
+    run_local(_cfg(str(path)))
+    return path
+
+
+def _cfg(checkpoint_dir: str, **kw) -> SieveConfig:
+    base = dict(
+        n=N, backend="cpu-numpy", packing="wheel30", n_segments=4,
+        quiet=True, checkpoint_dir=checkpoint_dir,
+    )
+    base.update(kw)
+    return SieveConfig(**base)
+
+
+def _settings(**kw) -> ServiceSettings:
+    base = dict(
+        workers=2, queue_limit=16, default_deadline_s=10.0,
+        cold_chunk=1 << 16, breaker_cooldown_s=0.4, refresh_s=0.0,
+    )
+    base.update(kw)
+    return ServiceSettings(**base)
+
+
+# --- settings validation (satellite 1) ---------------------------------------
+
+
+@pytest.mark.parametrize("field,value,needle", [
+    ("queue_limit", 0, "queue_limit=0"),
+    ("workers", -1, "workers=-1"),
+    ("hot_queue_limit", 0, "hot_queue_limit=0"),
+    ("cold_queue_limit", -3, "cold_queue_limit=-3"),
+    ("hot_workers", -1, "hot_workers=-1"),
+    ("cold_age_s", -0.5, "cold_age_s=-0.5"),
+    ("cold_age_s", float("nan"), "cold_age_s=nan"),
+    ("default_deadline_s", 0, "default_deadline_s=0"),
+    ("breaker_fails", "3", "breaker_fails='3'"),
+])
+def test_validate_names_the_bad_setting(field, value, needle):
+    with pytest.raises(ValueError) as ei:
+        ServiceSettings(**{field: value}).validate()
+    assert needle in str(ei.value)
+
+
+def test_validate_accepts_defaults_and_lane_inheritance():
+    s = ServiceSettings().validate()
+    assert s.hot_queue_limit is None  # None inherits queue_limit: valid
+    assert ServiceSettings(hot_workers=0).validate().hot_workers == 0
+
+
+def test_bad_settings_fail_at_service_startup(ledger_dir):
+    # the whole point of validate(): a bad knob dies at construction,
+    # never as undefined runtime behavior in the admission plane
+    with pytest.raises(ValueError, match="workers=0"):
+        SieveService(_cfg(str(ledger_dir)), ServiceSettings(workers=0))
+
+
+def test_env_parse_failure_names_the_variable(monkeypatch):
+    monkeypatch.setenv("SIEVE_SVC_QUEUE", "lots")
+    with pytest.raises(ValueError, match="SIEVE_SVC_QUEUE='lots'"):
+        ServiceSettings.from_env()
+    monkeypatch.delenv("SIEVE_SVC_QUEUE")
+    monkeypatch.setenv("SIEVE_SVC_COLD_AGE_S", "fast")
+    with pytest.raises(ValueError, match="SIEVE_SVC_COLD_AGE_S='fast'"):
+        ServiceSettings.from_env()
+
+
+def test_env_lane_knobs_parse(monkeypatch):
+    monkeypatch.setenv("SIEVE_SVC_HOT_QUEUE", "8")
+    monkeypatch.setenv("SIEVE_SVC_HOT_WORKERS", "2")
+    monkeypatch.setenv("SIEVE_SVC_COLD_AGE_S", "0.25")
+    s = ServiceSettings.from_env()
+    assert (s.hot_queue_limit, s.hot_workers, s.cold_age_s) == (8, 2, 0.25)
+    assert s.cold_queue_limit is None  # unset env keeps the None default
+
+
+# --- malformed deadline_s (satellite 2) --------------------------------------
+
+
+@pytest.mark.parametrize("dl", [-1, 0, "nope", float("inf"), True])
+def test_bad_deadline_is_typed_bad_request(service, dl):
+    svc, cli = service
+    r = cli.query("pi", x=1000, deadline_s=dl)
+    assert r["ok"] is False
+    assert r["error"] == "bad_request"
+    assert "deadline_s" in r["detail"]
+    # the client connection survives a typed refusal
+    assert cli.pi(30_000) == o_pi(30_000)
+    assert svc.stats()["bad_requests"] >= 1
+
+
+# --- health/stats reply schema snapshot (satellite 3) ------------------------
+
+
+@pytest.fixture
+def service(ledger_dir):
+    with SieveService(_cfg(str(ledger_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            yield svc, cli
+
+
+def test_health_and_stats_key_schema_snapshot(service):
+    """Key-set snapshot: a removed or renamed field in either control
+    reply is an operator-visible wire break and must show up here."""
+    svc, cli = service
+    assert cli.pi(30_000) == o_pi(30_000)
+    assert sorted(cli.health()) == [
+        "brownout", "covered_hi", "draining", "id", "ok", "queue_depth",
+        "queue_depth_cold", "queue_depth_hot", "refreshes",
+        "snapshot_age_s", "status", "total_primes", "type",
+    ]
+    assert sorted(cli.stats()) == [
+        "bad_requests", "brownout", "coalesced", "cold_admitted",
+        "cold_batched_chunks", "cold_cache_hits", "cold_computes",
+        "cold_dispatches", "cold_persisted", "covered_hi",
+        "deadline_exceeded", "degraded", "degraded_replies", "demoted",
+        "draining", "draining_replies", "dropped_segments",
+        "hot_admitted", "hot_workers_dedicated", "index_hits",
+        "internal_errors", "lane_shed_cold", "lane_shed_hot",
+        "lru_entries", "lru_hits", "materialized", "persist_cold",
+        "queue_depth", "queue_depth_cold", "queue_depth_hot",
+        "refresh_attempts", "refresh_failed", "refreshes", "requests",
+        "segments", "shed", "snapshot_age_s", "total_primes",
+    ]
+
+
+# --- classification ----------------------------------------------------------
+
+
+def test_classification_hot_vs_cold(service):
+    svc, cli = service
+    idx = svc.index
+    hi = idx.covered_hi
+    q = lambda **m: svc._classify(m, idx)
+    assert q(op="pi", x=hi - 1) == "hot"
+    assert q(op="pi", x=2 * hi) == "cold"
+    assert q(op="count", lo=10, hi=hi) == "hot"
+    assert q(op="count", lo=10, hi=hi + 1000) == "cold"
+    assert q(op="count", lo=10, hi=2 * hi, kind="twin") == "cold"
+    assert q(op="nth_prime", k=idx.total_primes) == "hot"
+    assert q(op="nth_prime", k=idx.total_primes + 1) == "cold"
+    assert q(op="primes", lo=10, hi=hi) == "hot"
+    assert q(op="primes", lo=10, hi=hi + 1) == "cold"
+    # malformed / unknown queries are hot: a typed bad_request is cheap
+    # and must never queue behind a cold flood
+    assert q(op="pi", x="bad") == "hot"
+    assert q(op="count", lo=50, hi=10) == "hot"  # hi < lo: bad_request
+    assert q(op="no_such_op") == "hot"
+    assert q(op="pi") == "hot"  # missing arg
+
+
+def test_cold_cache_promotes_to_hot(service):
+    svc, cli = service
+    x = svc.index.covered_hi + 10_000
+    assert svc._classify({"op": "pi", "x": x}, svc.index) == "cold"
+    assert cli.pi(x) == o_pi(x)  # fills the cold chunk cache
+    assert svc._classify({"op": "pi", "x": x}, svc.index) == "hot"
+    assert svc.stats()["cold_admitted"] >= 1
+    assert cli.pi(x) == o_pi(x)
+    assert svc.stats()["hot_admitted"] >= 1
+
+
+# --- cold flood: sheds carry lane, hot lane keeps answering ------------------
+
+
+def test_cold_shed_carries_lane_while_hot_answers(ledger_dir, memsink):
+    settings = _settings(
+        workers=2, hot_workers=1, queue_limit=16, cold_queue_limit=1,
+        cold_delay_s=0.4, cold_age_s=5.0,
+    )
+    with SieveService(_cfg(str(ledger_dir)), settings) as svc:
+        hi = svc.index.covered_hi
+        replies = []
+        rlock = threading.Lock()
+
+        def cold_query(i):
+            x = hi + (i + 1) * (1 << 16) - 1
+            with ServiceClient(svc.addr, timeout_s=30) as c:
+                r = c.query("pi", x=x)
+                with rlock:
+                    replies.append((x, r))
+
+        threads = [threading.Thread(target=cold_query, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        # the dedicated hot worker keeps answering under the flood
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            for x in range(5_000, 45_000, 5_000):
+                assert cli.pi(x) == o_pi(x)
+        for t in threads:
+            t.join()
+        shed = [(x, r) for x, r in replies if not r["ok"]]
+        assert shed, "cold lane at limit 1 must shed under 6 queries"
+        for _x, r in shed:
+            assert r["error"] == "overloaded"
+            assert r["lane"] == "cold"
+            assert "cold lane" in r["detail"]
+        for x, r in replies:
+            if r["ok"]:
+                assert r["value"] == o_pi(x)  # admitted cold stays exact
+        st = svc.stats()
+        assert st["lane_shed_cold"] == len(shed)
+        assert st["lane_shed_hot"] == 0
+    evs = [x for x in memsink.records
+           if x["event"] == "service_lane_shed"]
+    assert evs and all(e["lane"] == "cold" for e in evs)
+    for x in memsink.records:
+        validate_record(x)
+
+
+def test_brownout_halves_cold_limit(ledger_dir):
+    # unstarted service: no workers drain the lanes we stuff by hand
+    svc = SieveService(
+        _cfg(str(ledger_dir)),
+        _settings(hot_queue_limit=8, cold_queue_limit=8),
+    )
+    assert svc.brownout() is False
+    with svc._lane_cond:
+        assert svc._lane_limit_locked("cold") == 8
+    svc._lanes["hot"].extend(object() for _ in range(4))  # half of 8
+    assert svc.brownout() is True
+    with svc._lane_cond:
+        assert svc._lane_limit_locked("cold") == 4
+        assert svc._lane_limit_locked("hot") == 8  # hot never halves
+    svc._lanes["hot"].clear()
+    assert svc.brownout() is False
+
+
+# --- demotion: a misclassified hot query hands off to the cold lane ----------
+
+
+def test_misclassified_hot_query_demotes_and_answers(ledger_dir, memsink):
+    with SieveService(_cfg(str(ledger_dir)),
+                      _settings(workers=2, hot_workers=1)) as svc:
+        svc._classify = lambda msg, idx: "hot"  # force misclassification
+        x = svc.index.covered_hi + 5_000
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            assert cli.pi(x) == o_pi(x)  # exact despite the wrong lane
+        st = svc.stats()
+        assert st["demoted"] >= 1
+        assert st["hot_admitted"] >= 1
+        # the demoted re-enqueue must not double-count the request
+        assert st["requests"] == 1
+    evs = [x for x in memsink.records if x["event"] == "service_demoted"]
+    assert evs and evs[0]["op"] == "pi" and evs[0]["chunks"] >= 1
+    for x in memsink.records:
+        validate_record(x)
+
+
+# --- svc_flood chaos + ReplicaSet failover -----------------------------------
+
+
+def test_svc_flood_grammar():
+    (d,) = parse_chaos("svc_flood:any@s3:hot")
+    assert (d.kind, d.seg_id, d.param) == ("svc_flood", 3, "hot")
+    (d,) = parse_chaos("svc_flood:any@s1")
+    assert d.param == "cold"  # default lane
+    with pytest.raises(ValueError, match="must be a lane"):
+        parse_chaos("svc_flood:any@s1:luke")
+    with pytest.raises(ValueError, match="must be a lane"):
+        parse_chaos("svc_flood:any@s1:0.5")
+
+
+def test_svc_flood_injects_lane_shed(service, memsink):
+    svc, cli = service
+    svc.inject_chaos(f"svc_flood:any@s{svc._seq + 1}:cold")
+    r = cli.query("pi", x=1_000)  # would classify hot; flood wins
+    assert r["ok"] is False
+    assert r["error"] == "overloaded"
+    assert r["lane"] == "cold"
+    assert "svc_flood" in r["detail"]
+    assert cli.pi(1_000) == o_pi(1_000)  # one-shot: next request admits
+    assert svc.stats()["lane_shed_cold"] >= 1
+    evs = [x for x in memsink.records if x["event"] == "service_lane_shed"]
+    assert evs and evs[-1]["lane"] == "cold"
+    for x in memsink.records:
+        validate_record(x)
+
+
+def test_replicaset_fails_over_on_flood_shed(ledger_dir):
+    cfg = _cfg(str(ledger_dir))
+    with SieveService(cfg, _settings()) as a, \
+            SieveService(cfg, _settings()) as b:
+        # round-robin starts at replica 0: A sheds typed overloaded
+        # (lane cold) via the injected flood, the set retries B —
+        # exact answer, no client-visible error, no client change
+        a.inject_chaos(f"svc_flood:any@s{a._seq + 1}:cold")
+        with ReplicaSet([a.addr, b.addr], timeout_s=10,
+                        backoff_base_s=0.01) as rs:
+            assert rs.pi(30_000) == o_pi(30_000)
+            assert rs.failovers >= 1
+        assert a.stats()["lane_shed_cold"] >= 1
+
+
+# --- trace_report per-lane rows ----------------------------------------------
+
+
+def test_trace_report_renders_per_lane_rows(service):
+    svc, cli = service
+    tr = trace.get_tracer()
+    tr.enable()
+    try:
+        assert cli.pi(30_000) == o_pi(30_000)  # hot
+        x = svc.index.covered_hi + 70_000
+        assert cli.pi(x) == o_pi(x)  # cold
+    finally:
+        tr.disable()
+    from tools.trace_report import service_report
+
+    spans = [e for e in tr.events() if e.get("ph") == "X"]
+    lanes = {(e.get("args") or {}).get("lane")
+             for e in spans if e["name"] == "rpc.query"}
+    assert lanes >= {"hot", "cold"}
+    text = "\n".join(service_report(spans))
+    assert "lane" in text and "wait p95 ms" in text
+    hot_row = next(ln for ln in text.splitlines()
+                   if ln.strip().startswith("hot"))
+    cold_row = next(ln for ln in text.splitlines()
+                    if ln.strip().startswith("cold"))
+    assert hot_row and cold_row
+    # pre-lane traces (no lane arg) skip the block instead of crashing
+    stripped = [dict(e, args={}) for e in spans]
+    assert "lane" not in "\n".join(service_report(stripped))

@@ -72,10 +72,18 @@ def compare(
     """(report lines, regression descriptions) for old -> new."""
     lines: list[str] = []
     regressions: list[str] = []
+    new_names: list[str] = []
     for name in sorted(old.keys() | new.keys()):
         o, n = old.get(name), new.get(name)
         if o is None:
-            lines.append(f"  {name}: NEW  {n['value']:.4g} {n.get('unit', '')}")
+            # a metric present only in the newest round is reported
+            # explicitly (it becomes next round's baseline), never
+            # silently ignored
+            new_names.append(name)
+            lines.append(
+                f"  {name}: NEW metric (no previous round) "
+                f"{n['value']:.4g} {n.get('unit', '')}"
+            )
             continue
         if n is None:
             lines.append(f"  {name}: GONE (was {o['value']:.4g})")
@@ -100,6 +108,11 @@ def compare(
         lines.append(
             f"  {name}: {ov:.4g} -> {nv:.4g} {unit} "
             f"({delta:+.1%}){verdict}"
+        )
+    if new_names:
+        lines.append(
+            f"  {len(new_names)} new metric(s) this round "
+            f"(baseline from next round): {', '.join(new_names)}"
         )
     return lines, regressions
 

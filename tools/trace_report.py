@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 
@@ -221,6 +222,35 @@ def service_report(spans: list[dict]) -> list[str]:
         f"    {'index/other':<18} {other / 1e3:>10.3f} ms "
         f"{100 * other / total if total else 0:>6.1f}%"
     )
+    # priority lanes (ISSUE 10): per-lane request latency + queue wait.
+    # Traces from pre-lane servers carry no lane arg and skip the block.
+    by_lane: dict[str, list[float]] = {}
+    for e in rpc:
+        lane = (e.get("args") or {}).get("lane")
+        if lane is not None:
+            by_lane.setdefault(str(lane), []).append(e["dur"])
+    if by_lane:
+        waits: dict[str, list[float]] = {}
+        for e in spans:
+            if e["name"] != "query.queue_wait":
+                continue
+            lane = (e.get("args") or {}).get("lane")
+            if lane is not None:
+                waits.setdefault(str(lane), []).append(e["dur"])
+        lines.append(
+            f"  {'lane':<6} {'count':>6} {'mean ms':>9} {'p95 ms':>9} "
+            f"{'max ms':>9} {'wait p95 ms':>12}"
+        )
+        for lane in sorted(by_lane):
+            durs = sorted(by_lane[lane])
+            p95 = durs[max(0, math.ceil(0.95 * len(durs)) - 1)]
+            w = sorted(waits.get(lane, []))
+            wp95 = w[max(0, math.ceil(0.95 * len(w)) - 1)] if w else 0.0
+            lines.append(
+                f"  {lane:<6} {len(durs):>6} "
+                f"{sum(durs) / len(durs) / 1e3:>9.3f} {p95 / 1e3:>9.3f} "
+                f"{max(durs) / 1e3:>9.3f} {wp95 / 1e3:>12.3f}"
+            )
     return lines
 
 
