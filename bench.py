@@ -2,7 +2,7 @@
 host-prepare pipeline, the fused-reduction bandwidth model, and the
 query-service latency profile.
 
-Prints FIVE JSON lines {"metric", "value", "unit", "vs_baseline"}:
+Prints SEVEN JSON lines {"metric", "value", "unit", "vs_baseline"}:
 
 1. pi(1e9), odds packing, tpu-pallas backend — the shallow regime.
    Baseline: BASELINE.md's measured CPU floor — pi(1e9) segmented numpy
@@ -35,6 +35,18 @@ Prints FIVE JSON lines {"metric", "value", "unit", "vs_baseline"}:
    headline value is the overall p95 in ms (unit ``ms_p95`` — gated
    UPWARD by tools/bench_compare.py: a >10% p95 increase between rounds
    fails); vs_baseline = 50 ms budget / p95, so >= 1 is within budget.
+   Host-only: emitted anywhere.
+6. Hot-lane p95 under a cold flood (ISSUE 10): the same ``ms_p95``
+   gate applied to hot-lane ``rpc.query`` spans while 20 threads
+   saturate the cold plane — the lane-isolation guarantee as a number.
+   vs_baseline = 50 ms budget / p95. Host-only: emitted anywhere.
+7. Router fabric latency (ISSUE 11): overall p95 in ms from the
+   ``rpc.route`` spans of a mixed workload against a two-shard
+   in-process fabric (each shard its own ledger slice, shard 1 serving
+   with ``range_lo``) — point routes, scatter-gather prefix counts
+   (cached full-shard totals + boundary queries), windowed counts, and
+   twin windows straddling the shard edge (the splice path). Unit
+   ``ms_p95`` (same upward gate); vs_baseline = 50 ms budget / p95.
    Host-only: emitted anywhere.
 
 Exact parity is asserted before any number is printed — the depth line
@@ -453,6 +465,157 @@ def service_hot_under_flood_metric() -> None:
     )
 
 
+def router_query_latency_metric() -> None:
+    """Router fabric metric (ISSUE 11): overall p95 ms from the
+    ``rpc.route`` spans of a mixed workload against a two-shard
+    in-process fabric. The source ledger is split 4+4 into per-shard
+    serving dirs (shard 1 runs with ``range_lo``); the workload mixes
+    point routes, scatter-gather prefix counts, windowed counts, and
+    twin windows that straddle the shard edge so the splice path is in
+    the measured distribution. Every reply is asserted exact against a
+    host oracle before timing counts; the stats snapshot must show both
+    full-shard totals cached and at least one splice."""
+    import tempfile
+
+    import numpy as np
+
+    from sieve import trace
+    from sieve.checkpoint import Ledger
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+    from sieve.seed import seed_primes
+    from sieve.service import (
+        RouterSettings,
+        ServiceClient,
+        ServiceSettings,
+        Shard,
+        ShardMap,
+        SieveRouter,
+        SieveService,
+    )
+
+    n = 2_000_000
+    oracle = seed_primes(n + 100_000)
+
+    def o_pi(x: int) -> int:
+        return int(np.searchsorted(oracle, x, side="right"))
+
+    def o_count(lo: int, hi: int) -> int:
+        return int(np.searchsorted(oracle, hi, side="left")
+                   - np.searchsorted(oracle, lo, side="left"))
+
+    def o_pairs(lo: int, hi: int, gap: int) -> int:
+        w = oracle[(oracle >= lo) & (oracle < hi)]
+        if w.size < 2:
+            return 0
+        idx = np.searchsorted(w, w + gap)
+        ok = idx < w.size
+        return int(np.count_nonzero(w[idx[ok]] == w[ok] + gap))
+
+    def shard_cfg(d: str) -> SieveConfig:
+        return SieveConfig(
+            n=n, backend="cpu-numpy", packing="odds", n_segments=8,
+            checkpoint_dir=d, quiet=True,
+        )
+
+    with tempfile.TemporaryDirectory(prefix="sieve_bench_router") as ck:
+        src = os.path.join(ck, "src")
+        run_local(shard_cfg(src))
+        segs = sorted(
+            Ledger.open_readonly(shard_cfg(src)).completed().values(),
+            key=lambda r: r.lo,
+        )
+        E = segs[4].lo  # shard edge on a segment boundary
+        dirs = [os.path.join(ck, f"shard{i}") for i in range(2)]
+        for d, part in zip(dirs, (segs[:4], segs[4:])):
+            led = Ledger.open(shard_cfg(d))
+            for r in part:
+                led.record(r)
+
+        trace.enable()
+        trace.drain_events()  # only this workload's spans are measured
+        svcs = [
+            SieveService(
+                shard_cfg(dirs[0]),
+                ServiceSettings(workers=4, queue_limit=64, refresh_s=0.0),
+            ).start(),
+            SieveService(
+                shard_cfg(dirs[1]),
+                ServiceSettings(workers=4, queue_limit=64, refresh_s=0.0,
+                                range_lo=E),
+            ).start(),
+        ]
+        smap = ShardMap([
+            Shard(2, E, (svcs[0].addr,)),
+            Shard(E, n + 1, (svcs[1].addr,)),
+        ])
+        router = SieveRouter(smap, RouterSettings(quiet=True)).start()
+        try:
+            with ServiceClient(router.addr, timeout_s=60) as cli:
+                # full-range prefix: caches BOTH full-shard totals
+                assert cli.pi(n) == o_pi(n), f"pi({n}) parity failure"
+                for i in range(120):  # scatter-gather prefix counts
+                    x = (7919 * (i + 1)) % n
+                    assert cli.pi(x) == o_pi(x), f"pi({x}) parity failure"
+                for i in range(60):   # windowed counts, both shards
+                    lo = (104_729 * (i + 1)) % (n - 60_000)
+                    want = o_count(lo, lo + 50_000)
+                    assert cli.count(lo, lo + 50_000) == want, \
+                        f"count({lo}) parity failure"
+                for i in range(40):   # point routes to one shard each
+                    x = (7907 * (i + 1)) % n
+                    got = cli.query("is_prime", x=x)
+                    assert got["ok"] and got["value"] == (o_count(x, x + 1) == 1), \
+                        f"is_prime({x}) parity failure"
+                for i in range(30):   # edge-straddling pair windows: splice
+                    lo, hi = E - 400 - 37 * i, E + 400 + 29 * i
+                    rep = cli.query("count", lo=lo, hi=hi, kind="twins")
+                    assert rep["ok"] and rep["value"] == o_pairs(lo, hi, 2), \
+                        f"twins({lo},{hi}) parity failure"
+                for i in range(10):   # nth_prime walks the cumulative totals
+                    k = o_pi(E - 1) - 5 + i  # straddles the edge count
+                    rep = cli.query("nth_prime", k=k)
+                    assert rep["ok"] and rep["value"] == int(oracle[k - 1]), \
+                        f"nth_prime({k}) parity failure"
+                st = cli.stats()
+                assert st["totals_cached"] == 2, "full-shard totals not cached"
+                assert st["spliced"] >= 1, "no edge splice in the workload"
+        finally:
+            router.stop()
+            for s in svcs:
+                s.stop()
+        events, _dropped = trace.drain_events()
+        trace.disable()
+    by_op: dict[str, list[float]] = {}
+    for e in events:
+        if e.get("name") == "rpc.route":
+            op = (e.get("args") or {}).get("op", "?")
+            by_op.setdefault(op, []).append(e["dur"] / 1000.0)  # us -> ms
+    assert by_op, "no rpc.route spans captured"
+    all_ms = [v for vals in by_op.values() for v in vals]
+    p95 = _pctile(all_ms, 0.95)
+    budget_ms = 50.0
+    print(
+        json.dumps(
+            {
+                "metric": "router_query_latency_p95_ms",
+                "value": round(p95, 3),
+                "unit": "ms_p95",
+                "vs_baseline": round(budget_ms / p95, 3) if p95 else None,
+                "p50_ms": round(_pctile(all_ms, 0.5), 3),
+                "ops": {
+                    op: {
+                        "n": len(vals),
+                        "p50_ms": round(_pctile(vals, 0.5), 3),
+                        "p95_ms": round(_pctile(vals, 0.95), 3),
+                    }
+                    for op, vals in sorted(by_op.items())
+                },
+            }
+        )
+    )
+
+
 def main() -> int:
     shallow_metric()
     depth_metric()
@@ -460,6 +623,7 @@ def main() -> int:
     fused_reduction_metric()
     service_latency_metric()
     service_hot_under_flood_metric()
+    router_query_latency_metric()
     return 0
 
 

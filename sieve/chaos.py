@@ -81,6 +81,19 @@ sequence number like the other ``svc_*`` request kinds):
                               only kind whose param is a lane name, not
                               seconds.
 
+Router plane (ISSUE 11; drawn by sieve/service/router.py on its own
+request sequence — here ``worker`` names a SHARD index, ``any`` every
+shard):
+
+* ``svc_shard_down:<shard>@sK:secs`` starting at router request K the
+                              named shard (or every shard, for ``any``)
+                              is treated as unreachable for ``secs``
+                              (default 1.0): queries needing it get a
+                              typed ``unavailable`` naming the shard,
+                              queries answerable from other shards stay
+                              exact — the whole-shard-outage drill
+                              without killing real replicas.
+
 ``worker`` is an integer id, or ``any``/``*`` for whichever worker draws
 the segment (the pull model makes a specific id probabilistic, ``any``
 deterministic). Directives are transported to the worker inside the
@@ -113,6 +126,7 @@ KINDS = (
     "svc_drain",
     "svc_batch_partial",
     "svc_flood",
+    "svc_shard_down",
 )
 # kinds handled by the query service (sieve/service/); the cluster plane
 # ignores these and vice versa. Request-scoped kinds key on the request
@@ -138,6 +152,10 @@ SERVICE_REQUEST_KINDS = (
     "svc_drain",
     "svc_flood",
 )
+# drawn by the router tier (ISSUE 11) on ITS request sequence; the
+# directive's worker field names a shard index there, so shard servers
+# never consume these even when one --chaos string drives both tiers
+ROUTER_REQUEST_KINDS = ("svc_shard_down",)
 # kinds whose param is a LANE NAME ("hot"/"cold"), not seconds
 LANE_PARAM_KINDS = ("svc_flood",)
 _LANES = ("hot", "cold")
@@ -158,6 +176,8 @@ DEFAULT_PARAM: dict[str, float | str | None] = {
     "svc_batch_partial": 0.0,
     # param = the lane to refuse admission on
     "svc_flood": "cold",
+    # param = seconds the shard stays unreachable to the router
+    "svc_shard_down": 1.0,
 }
 
 
@@ -172,8 +192,11 @@ class ChaosDirective:
         return self.seg_id == seg_id and self.worker in (ANY_WORKER, worker_id)
 
     def to_wire(self) -> dict:
-        """The per-assignment payload shipped to the worker."""
-        return {"kind": self.kind, "param": self.param}
+        """The per-assignment payload shipped to the worker. ``worker``
+        rides along for planes where it is an *address* rather than a
+        match key — the router reads it as a shard index (ANY_WORKER =
+        every shard); cluster workers ignore it."""
+        return {"kind": self.kind, "param": self.param, "worker": self.worker}
 
 
 def parse_chaos(spec: str) -> list[ChaosDirective]:

@@ -8,6 +8,14 @@ checkpoint dir (sieve/service/):
 
     python -m sieve serve --n 1e9 --segments 256 --checkpoint-dir ck \\
         --addr 127.0.0.1:7723
+
+The ``route`` subcommand fronts several such servers as one range-sharded
+fabric (sieve/service/router.py) — same wire protocol, zero client
+changes:
+
+    python -m sieve route --addr 127.0.0.1:7733 \\
+        --shard 2:5e8=127.0.0.1:7723,127.0.0.1:7724 \\
+        --shard 5e8:1e9=127.0.0.1:7725
 """
 
 from __future__ import annotations
@@ -142,6 +150,12 @@ def main(argv: list[str] | None = None) -> int:
         except (ValueError, RuntimeError, ImportError) as e:
             print(f"sieve: error: {e}", file=sys.stderr)
             return 2
+    if argv and argv[0] == "route":
+        try:
+            return _route(argv[1:])
+        except (ValueError, RuntimeError, ImportError) as e:
+            print(f"sieve: error: {e}", file=sys.stderr)
+            return 2
     args = build_parser().parse_args(argv)
     try:
         if args.emit_primes is not None:
@@ -199,6 +213,13 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "writer; covered_hi grows under read traffic and "
                         "replicas following the file inherit the work). "
                         "Default OFF / SIEVE_SVC_PERSIST_COLD")
+    p.add_argument("--range-lo", type=_parse_n, default=None, dest="range_lo",
+                   help="serve as a range SHARD covering [RANGE_LO, N]: "
+                        "count/primes below RANGE_LO are rejected typed, "
+                        "counts anchor at RANGE_LO instead of 2, and pi "
+                        "(a global-prefix op) is refused — the router "
+                        "(python -m sieve route) owns global composition "
+                        "(default SIEVE_SVC_RANGE_LO/2)")
     p.add_argument("--allow-chaos", action="store_true",
                    help="accept wire-injected chaos messages (default OFF: "
                         "a refused injection gets a typed bad_request and "
@@ -247,6 +268,8 @@ def _serve(argv: list[str]) -> int:
         overrides["drain_s"] = args.drain_s
     if args.allow_chaos:
         overrides["wire_chaos"] = True
+    if args.range_lo is not None:
+        overrides["range_lo"] = args.range_lo
     if args.persist_cold:
         if not args.checkpoint_dir:
             raise ValueError("--persist-cold needs --checkpoint-dir (the "
@@ -293,6 +316,129 @@ def _serve(argv: list[str]) -> int:
         if config.trace_file:
             trace.disable()
             trace.save(config.trace_file)
+        if file_sink is not None:
+            metrics.remove_sink(file_sink)
+            file_sink.close()
+    return 0
+
+
+def build_route_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sieve route",
+        description="Range-shard router: one RPC front door over shard "
+                    "replica sets (sieve/service/router.py). Speaks the "
+                    "same wire protocol as serve on both sides, so "
+                    "existing clients need zero changes.",
+    )
+    p.add_argument("--addr", default="127.0.0.1:7733",
+                   help="listen address host:port (port 0 picks a free one; "
+                        "the chosen address is printed as a JSON line)")
+    p.add_argument("--shard", action="append", default=None, metavar="LO:HI=ADDRS",
+                   help="one shard covering [LO, HI) backed by comma-"
+                        "separated replica addresses, e.g. "
+                        "--shard 2:1e6=127.0.0.1:7723,127.0.0.1:7724 "
+                        "(repeat per shard; shards must tile the range "
+                        "contiguously). Alternative to --shard-map")
+    p.add_argument("--shard-map", default=None, metavar="FILE",
+                   help="JSON shard map file: {\"shards\": [{\"lo\", \"hi\", "
+                        "\"addrs\"}, ...]}")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="default per-request deadline; the REMAINING budget "
+                        "is forwarded to every downstream shard call "
+                        "(default 30)")
+    p.add_argument("--timeout-s", type=float, default=None,
+                   help="downstream socket timeout (default 60)")
+    p.add_argument("--probe-ttl-s", type=float, default=None,
+                   help="shard health-probe cache TTL; 0 re-probes every "
+                        "selection (default 2.0)")
+    p.add_argument("--rounds", type=int, default=None,
+                   help="failover sweeps across each shard's replicas "
+                        "before giving up (default 2)")
+    p.add_argument("--drain-s", type=float, default=None,
+                   help="graceful-drain budget after SIGTERM/shutdown "
+                        "(default 5.0)")
+    p.add_argument("--allow-chaos", action="store_true",
+                   help="accept wire-injected chaos messages (default OFF)")
+    p.add_argument("--chaos", default=None,
+                   help="router fault schedule, e.g. 'svc_shard_down:1@s3:"
+                        "2.0' (segment number = router request sequence; "
+                        "worker = shard index, any = every shard)")
+    p.add_argument("--trace", default=None, dest="trace_file", metavar="FILE",
+                   help="write rpc.route / route.scatter spans as Chrome "
+                        "trace-event JSON on shutdown")
+    p.add_argument("--metrics-file", default=None, dest="metrics_file")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress per-request stderr event lines")
+    return p
+
+
+def _route(argv: list[str]) -> int:
+    args = build_route_parser().parse_args(argv)
+
+    from sieve import metrics, trace
+    from sieve.service import RouterSettings, ShardMap, SieveRouter
+
+    if bool(args.shard) == bool(args.shard_map):
+        raise ValueError("route needs exactly one of --shard (repeatable) "
+                         "or --shard-map FILE")
+    if args.shard_map:
+        shardmap = ShardMap.from_json(args.shard_map)
+    else:
+        shardmap = ShardMap.from_flags(args.shard)
+
+    overrides = {}
+    if args.deadline_s is not None:
+        overrides["default_deadline_s"] = args.deadline_s
+    if args.timeout_s is not None:
+        overrides["timeout_s"] = args.timeout_s
+    if args.probe_ttl_s is not None:
+        overrides["probe_ttl_s"] = args.probe_ttl_s
+    if args.rounds is not None:
+        overrides["rounds"] = args.rounds
+    if args.drain_s is not None:
+        overrides["drain_s"] = args.drain_s
+    if args.allow_chaos:
+        overrides["wire_chaos"] = True
+    if args.quiet:
+        overrides["quiet"] = True
+    settings = RouterSettings(**overrides)
+
+    file_sink = None
+    if args.metrics_file:
+        file_sink = metrics.FileSink(args.metrics_file)
+        metrics.add_sink(file_sink)
+    if args.trace_file:
+        trace.enable()
+    router = SieveRouter(shardmap, settings, addr=args.addr,
+                         chaos_spec=args.chaos or "")
+    try:
+        router.start()
+        # one parseable line so wrappers (tools/shard_smoke.py) can find
+        # the bound port when --addr uses port 0
+        print(json.dumps({
+            "event": "routing",
+            "addr": router.addr,
+            "range": [shardmap.lo, shardmap.hi],
+            "shards": [s.to_dict() for s in shardmap],
+        }), flush=True)
+        import signal
+
+        signal.signal(signal.SIGTERM, lambda *_: router.drain())
+        router.drain_event.wait()  # route until SIGTERM/shutdown
+        drained = router.wait_drained(settings.drain_s)
+        print(json.dumps({
+            "event": "drained",
+            "clean": drained,
+            "stats": {k: router.stats()[k]
+                      for k in ("requests", "draining_replies")},
+        }), flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+        if args.trace_file:
+            trace.disable()
+            trace.save(args.trace_file)
         if file_sink is not None:
             metrics.remove_sink(file_sink)
             file_sink.close()

@@ -96,6 +96,18 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     # the cold lane ("chunks" = how many chunks needed a dispatch).
     "service_lane_shed": {"op", "lane", "queue_depth"},
     "service_demoted": {"op", "chunks"},
+    # router fabric (ISSUE 11): one router_request per routed query
+    # ("shards" = how many shards the scatter touched; point routes say
+    # 1); router_shard_down marks a shard held unreachable (chaos window
+    # or exhausted replicas — "reason" says which); router_spliced marks
+    # a cross-shard pair stitch at a shard edge ("pair_kind" twins /
+    # cousins, "pairs" = pairs crossing that edge). router_drain and
+    # router_chaos_refused mirror their service_ counterparts.
+    "router_request": {"op", "outcome", "shards", "ms"},
+    "router_shard_down": {"shard", "reason"},
+    "router_spliced": {"edge", "pair_kind", "pairs"},
+    "router_drain": {"inflight"},
+    "router_chaos_refused": {"spec"},
 }
 
 

@@ -39,6 +39,19 @@ brownout (under hot backlog the cold lane sheds first), and demotion
 (a hot query that discovers a cold chunk mid-execution hands off to
 the cold lane). Typed ``overloaded`` sheds carry the lane; the
 ``svc_flood`` chaos kind injects them deterministically.
+
+Range-sharded fabric (ISSUE 11): :mod:`sieve.service.shards` partitions
+[2, N] into contiguous :class:`Shard` ranges (a validated
+:class:`ShardMap`), each backed by its own ledger and replica set, and
+:mod:`sieve.service.router` fronts them with :class:`SieveRouter` —
+the same wire protocol on both sides, so clients need zero changes.
+Point queries range-route to one shard; ``pi``/``count`` scatter-gather
+as cached full-shard totals plus boundary-shard queries; twin/cousin
+counts are spliced across shard edges; deadline budgets, lane-aware
+sheds, and per-shard failover compose through the fabric. Shard servers
+run with ``--range-lo`` and refuse global-prefix ops — composition is
+the router's job. ``python -m sieve route`` is the CLI front door; the
+``svc_shard_down`` chaos kind drills whole-shard outages.
 """
 
 from sieve.service.client import (
@@ -48,6 +61,7 @@ from sieve.service.client import (
     ServiceError,
 )
 from sieve.service.index import QueryCtx, SieveIndex
+from sieve.service.router import RouterSettings, ShardUnavailable, SieveRouter
 from sieve.service.server import (
     BadRequest,
     ColdBatcher,
@@ -59,6 +73,7 @@ from sieve.service.server import (
     ServiceSettings,
     SieveService,
 )
+from sieve.service.shards import Shard, ShardMap
 
 __all__ = [
     "BadRequest",
@@ -71,9 +86,14 @@ __all__ = [
     "Overloaded",
     "QueryCtx",
     "ReplicaSet",
+    "RouterSettings",
     "ServiceClient",
     "ServiceError",
     "ServiceSettings",
+    "Shard",
+    "ShardMap",
+    "ShardUnavailable",
     "SieveIndex",
+    "SieveRouter",
     "SieveService",
 ]

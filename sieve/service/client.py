@@ -37,15 +37,21 @@ import threading
 import time
 from typing import Any, Sequence
 
+from sieve.metrics import registry
 from sieve.rpc import parse_addr, recv_msg, send_msg
 
 
 class ServiceError(RuntimeError):
-    def __init__(self, kind: str, detail: str, partial: dict | None = None):
+    def __init__(self, kind: str, detail: str, partial: dict | None = None,
+                 shard: int | None = None):
         super().__init__(f"{kind}: {detail}")
         self.kind = kind
         self.detail = detail
         self.partial = partial
+        # multi-hop provenance (ISSUE 11): when the reply crossed the
+        # router tier, which shard the error originated on (None for a
+        # direct single-server reply or a router-level error)
+        self.shard = shard
 
 
 class CallTimeout(ServiceError):
@@ -116,12 +122,16 @@ class ServiceClient:
             reply.get("error", "internal"),
             reply.get("detail", ""),
             reply.get("partial"),
+            shard=reply.get("shard"),
         )
 
     # --- ops -------------------------------------------------------------
 
     def pi(self, x: int, deadline_s: float | None = None) -> int:
         return self._value(self.query("pi", deadline_s, x=x))
+
+    def is_prime(self, x: int, deadline_s: float | None = None) -> bool:
+        return bool(self._value(self.query("is_prime", deadline_s, x=x)))
 
     def count(self, lo: int, hi: int, kind: str = "primes",
               deadline_s: float | None = None) -> int:
@@ -173,7 +183,9 @@ class _Replica:
         self.lock = threading.Lock()
         self.fails = 0
         self.open_until = 0.0
-        self.probed = False
+        # monotonic timestamp of the last successful health probe
+        # (0.0 = never / invalidated by _mark_down)
+        self.probed = 0.0
 
 
 class ReplicaSet:
@@ -194,6 +206,7 @@ class ReplicaSet:
         backoff_base_s: float = 0.05,
         backoff_cap_s: float = 1.0,
         circuit_cooldown_s: float = 1.0,
+        probe_ttl_s: float | None = None,
     ):
         if not addrs:
             raise ValueError("ReplicaSet needs at least one address")
@@ -204,6 +217,12 @@ class ReplicaSet:
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
         self.circuit_cooldown_s = circuit_cooldown_s
+        # probe freshness (ISSUE 11): None keeps the legacy contract — a
+        # replica probes once and stays trusted until marked down. The
+        # router passes a short TTL so per-request shard selection never
+        # adds a probe round-trip on the hot path yet still re-detects
+        # draining replicas within one TTL.
+        self.probe_ttl_s = probe_ttl_s
         self._lock = threading.Lock()
         self._rr = 0
         # observability for tools/tests: how often selection failed over
@@ -246,7 +265,7 @@ class ReplicaSet:
                 self.circuit_cooldown_s * (2 ** min(rep.fails - 1, 6)),
             )
             rep.open_until = time.monotonic() + cooldown
-            rep.probed = False
+            rep.probed = 0.0
         with rep.lock:
             if rep.client is not None:
                 rep.client.close()
@@ -257,26 +276,39 @@ class ReplicaSet:
             rep.fails = 0
             rep.open_until = 0.0
 
+    def _probe_fresh(self, rep: _Replica, now: float) -> bool:
+        if rep.probed <= 0.0:
+            return False
+        if self.probe_ttl_s is None:  # legacy: trusted until marked down
+            return True
+        return now - rep.probed <= self.probe_ttl_s
+
     def _ensure_client(self, rep: _Replica) -> ServiceClient:
         """Connect + health-probe (caller holds rep.lock). A replica that
         was marked down — or never used — must prove itself with a probe
         before it gets real queries; a draining replica fails the probe
         so rolling restarts steer new work away without a single typed
-        ``draining`` round-trip wasted."""
+        ``draining`` round-trip wasted. With ``probe_ttl_s`` set, a probe
+        stays trusted for that window — the counters make the cache
+        provable (``router.probe_cached`` vs ``router.probe_sent``)."""
         if rep.client is None:
             rep.client = ServiceClient(rep.addr, timeout_s=self.timeout_s)
-            rep.probed = False
-        if not rep.probed:
-            rep.client._sock.settimeout(self.probe_timeout_s)
-            try:
-                health = rep.client.health()
-            finally:
-                rep.client._sock.settimeout(self.timeout_s)
-            with self._lock:
-                self.probes += 1
-            if health.get("draining"):
-                raise ServiceError("draining", f"{rep.addr} is draining")
-            rep.probed = True
+            rep.probed = 0.0
+        now = time.monotonic()
+        if self._probe_fresh(rep, now):
+            registry().counter("router.probe_cached").inc()
+            return rep.client
+        registry().counter("router.probe_sent").inc()
+        rep.client._sock.settimeout(self.probe_timeout_s)
+        try:
+            health = rep.client.health()
+        finally:
+            rep.client._sock.settimeout(self.timeout_s)
+        with self._lock:
+            self.probes += 1
+        if health.get("draining"):
+            raise ServiceError("draining", f"{rep.addr} is draining")
+        rep.probed = time.monotonic()
         return rep.client
 
     # --- calls ------------------------------------------------------------
@@ -329,6 +361,33 @@ class ReplicaSet:
             f"{len(self._replicas)} replicas (last: {last_err!r})",
         )
 
+    def health(self) -> dict:
+        """Health of the first reachable replica (no probe gate: a
+        draining replica's health is exactly what the caller wants to
+        see). Used by the router to aggregate per-shard health."""
+        last_err: Exception | None = None
+        for rep in self._candidates():
+            try:
+                with rep.lock:
+                    if rep.client is None:
+                        rep.client = ServiceClient(
+                            rep.addr, timeout_s=self.timeout_s
+                        )
+                    rep.client._sock.settimeout(self.probe_timeout_s)
+                    try:
+                        return rep.client.health()
+                    finally:
+                        if rep.client is not None:
+                            rep.client._sock.settimeout(self.timeout_s)
+            except (ConnectionError, OSError, CallTimeout) as e:
+                self._mark_down(rep)
+                last_err = e
+        raise ServiceError(
+            "unavailable",
+            f"no replica health over {len(self._replicas)} replicas "
+            f"(last: {last_err!r})",
+        )
+
     def _value(self, reply: dict):
         if reply.get("ok"):
             return reply["value"]
@@ -336,12 +395,16 @@ class ReplicaSet:
             reply.get("error", "internal"),
             reply.get("detail", ""),
             reply.get("partial"),
+            shard=reply.get("shard"),
         )
 
     # --- ops (same surface as ServiceClient) ------------------------------
 
     def pi(self, x: int, deadline_s: float | None = None) -> int:
         return self._value(self.query("pi", deadline_s, x=x))
+
+    def is_prime(self, x: int, deadline_s: float | None = None) -> bool:
+        return bool(self._value(self.query("is_prime", deadline_s, x=x)))
 
     def count(self, lo: int, hi: int, kind: str = "primes",
               deadline_s: float | None = None) -> int:

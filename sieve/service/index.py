@@ -8,10 +8,12 @@ partial chunk only; those are materialized by the local numpy marking in
 bounded chunks and kept in an LRU so a repeated hot query re-sieves
 nothing (lru_hits vs materialized counters make that provable).
 
-Only the *contiguous* prefix of segments starting at 2 is indexed: a
-partially-sieved ledger may have holes (cluster runs complete segments
-out of order), and a prefix count across a hole would be wrong. Ranges
-past :attr:`SieveIndex.covered_hi` are the server's cold tier.
+Only the *contiguous* prefix of segments starting at ``base`` (2 for a
+whole-range server, the shard's lower bound for a range-sharded one,
+ISSUE 11) is indexed: a partially-sieved ledger may have holes (cluster
+runs complete segments out of order), and a prefix count across a hole
+would be wrong. Ranges past :attr:`SieveIndex.covered_hi` are the
+server's cold tier.
 
 Per-query bookkeeping travels in a :class:`QueryCtx`: which tiers were
 touched (drives the ``source`` field and the index-hit counter), the
@@ -113,16 +115,22 @@ class SieveIndex:
         entries: dict[int, SegmentResult] | Sequence[SegmentResult],
         lru_segments: int = 32,
         lru: BitsetLRU | None = None,
+        base: int = 2,
     ):
         self.packing = packing
         self.layout = get_layout(packing)
+        # range-sharded servers (ISSUE 11) anchor their contiguous prefix
+        # at the shard's lower bound instead of 2; counts are then "primes
+        # in [base, v)" and nth is "k-th prime >= base" — exactly the
+        # shard-local semantics the router composes from cumulative totals
+        self.base = max(2, int(base))
         segs = sorted(
             entries.values() if isinstance(entries, dict) else entries,
             key=lambda r: r.lo,
         )
-        # contiguous prefix from 2 only — counts across a hole are wrong
+        # contiguous prefix from base only — counts across a hole are wrong
         self.segments: list[SegmentResult] = []
-        want_lo = 2
+        want_lo = self.base
         for r in segs:
             if r.lo != want_lo:
                 break
@@ -133,7 +141,7 @@ class SieveIndex:
         self._prefix = np.cumsum(
             [r.count for r in self.segments], dtype=np.int64
         )
-        self.covered_hi = self._his[-1] if self.segments else 2
+        self.covered_hi = self._his[-1] if self.segments else self.base
         self.total_primes = int(self._prefix[-1]) if self.segments else 0
         self.bounds: list[int] = [r.lo for r in self.segments] + (
             [self.covered_hi] if self.segments else []
@@ -206,12 +214,12 @@ class SieveIndex:
     # --- prefix counts ---------------------------------------------------
 
     def count_upto(self, v: int, ctx: QueryCtx) -> int:
-        """Primes in [2, v), for 2 <= v <= covered_hi.
+        """Primes in [base, v), for base <= v <= covered_hi.
 
         Boundary hits are pure O(log segments); interior values add a
         partial in-segment count over materialized chunks."""
-        if v <= 2:
-            ctx.answered_hi = max(ctx.answered_hi, 2)
+        if v <= self.base:
+            ctx.answered_hi = max(ctx.answered_hi, self.base)
             return 0
         if v > self.covered_hi:
             raise ValueError(

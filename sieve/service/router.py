@@ -1,0 +1,877 @@
+"""Range-sharded router tier (ISSUE 11 tentpole).
+
+:class:`SieveRouter` fronts N contiguous range shards — each its own
+ledger + :class:`~sieve.service.client.ReplicaSet` — behind the exact
+wire protocol the single server speaks (sieve/rpc.py framing, the same
+query/health/stats/shutdown/chaos message types, the same typed error
+kinds). Clients need zero changes: a :class:`ServiceClient` pointed at
+the router cannot tell it is not one server, except that the served
+range is the union of every shard's.
+
+Routing semantics, per op:
+
+* ``is_prime(x)`` / ``nth_prime(k)`` — point queries, routed to ONE
+  shard: ``is_prime`` by range (values past the map route to the last
+  shard, whose cold tier extends the fabric), ``nth_prime`` by walking
+  cumulative per-shard totals and forwarding ``k - primes_below`` to the
+  owning shard (shard servers anchor at ``range_lo``, so their ``nth``
+  is natively "k-th prime >= shard.lo").
+* ``pi(x)`` / ``count(lo, hi)`` — scatter-gather as the sum of
+  fully-covered shard TOTALS (cached forever: a full-shard prime count
+  is an immutable math fact) plus at most two boundary-shard counts.
+* ``count(lo, hi, twins|cousins)`` — per-shard pair counts (both
+  members inside the shard window) plus an edge SPLICE per interior
+  boundary E: primes in [E-gap, E) from the left shard and [E, E+gap)
+  from the right are matched to count the pairs that straddle E —
+  the same boundary-window trick the mesh merge uses for cross-device
+  pairs. ``ShardMap.MIN_SPAN`` guarantees a pair straddles at most one
+  edge.
+* ``primes(lo, hi)`` — per-shard enumerations concatenated ascending.
+
+Failure semantics compose from the PR 8 client: per-shard failover and
+circuit state live in each ReplicaSet (with ``probe_ttl_s`` so shard
+selection never adds a probe round-trip on the hot path); a shard whose
+replicas are all gone — or held down by the ``svc_shard_down`` chaos
+kind — surfaces as a typed ``unavailable`` reply NAMING the shard, and
+downstream typed sheds (``overloaded`` with its lane, ``degraded``,
+``draining``, ``deadline_exceeded`` with its partial) are relayed with
+a ``shard`` field attached. Deadline budgeting forwards the *remaining*
+deadline to every downstream call; scatters always run ascending, so a
+mid-scatter deadline yields the same contiguous-prefix partial contract
+the single server keeps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import socket
+import threading
+import types
+from typing import Any
+
+from sieve.chaos import (
+    ANY_WORKER,
+    ChaosSchedule,
+    ROUTER_REQUEST_KINDS,
+    parse_chaos,
+)
+from sieve.enumerate import MAX_HI
+from sieve.metrics import MetricsLogger, registry
+from sieve.rpc import parse_addr, recv_msg, send_msg
+from sieve.service.client import CallTimeout, ReplicaSet, ServiceError
+from sieve.service.server import BadRequest, DeadlineExceeded, Draining
+from sieve.service.shards import ShardMap
+from sieve import trace
+
+_PAIR_GAP = {"twins": 2, "cousins": 4}
+
+# error kinds a downstream shard can reply with that the router relays
+# verbatim (plus a "shard" field); anything else is the router's own
+_RELAY_KINDS = frozenset({
+    "overloaded", "degraded", "draining", "deadline_exceeded",
+    "bad_request", "internal", "timeout",
+})
+
+
+class ShardUnavailable(Exception):
+    """A shard's whole replica set is unreachable (or chaos-held down)."""
+
+    def __init__(self, shard: int, lo: int, hi: int, reason: str):
+        super().__init__(
+            f"shard {shard} [{lo}, {hi}) unavailable: {reason}"
+        )
+        self.shard = shard
+        self.lo = lo
+        self.hi = hi
+        self.reason = reason
+
+
+class _Relay(Exception):
+    """A downstream typed error to forward as-is, tagged with its shard."""
+
+    def __init__(self, reply: dict, shard: int):
+        super().__init__(reply.get("detail", reply.get("error", "")))
+        self.reply = reply
+        self.shard = shard
+
+
+@dataclasses.dataclass
+class RouterSettings:
+    """Router knobs; validated at construction like ServiceSettings."""
+
+    default_deadline_s: float = 30.0
+    # downstream ReplicaSet shape
+    timeout_s: float = 60.0
+    probe_timeout_s: float = 2.0
+    # probe freshness window (satellite 2): per-request shard selection
+    # must not pay a health round-trip, so probes are cached this long
+    probe_ttl_s: float = 2.0
+    rounds: int = 2
+    drain_s: float = 5.0
+    wire_chaos: bool = False
+    quiet: bool = False
+
+    def validate(self) -> "RouterSettings":
+        for name in ("default_deadline_s", "timeout_s", "probe_timeout_s"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v <= 0 or not math.isfinite(v):
+                raise ValueError(
+                    f"router settings: {name}={v!r} must be a positive "
+                    "number"
+                )
+        for name in ("probe_ttl_s", "drain_s"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0 or not math.isfinite(v):
+                raise ValueError(
+                    f"router settings: {name}={v!r} must be a non-negative "
+                    "number"
+                )
+        if not isinstance(self.rounds, int) or isinstance(self.rounds, bool) \
+                or self.rounds < 1:
+            raise ValueError(
+                f"router settings: rounds={self.rounds!r} must be a "
+                "positive integer"
+            )
+        return self
+
+
+class _RouteCtx:
+    """Per-request scatter bookkeeping: which shards were touched, the
+    contiguous prefix answered so far (for typed partials), splices."""
+
+    __slots__ = ("shards", "answered_hi", "count_so_far", "spliced")
+
+    def __init__(self) -> None:
+        self.shards: set[int] = set()
+        self.answered_hi = 2
+        self.count_so_far = 0
+        self.spliced = 0
+
+
+_ROUTER_STATS = (
+    "requests",
+    "routed_point",
+    "scattered",
+    "spliced",
+    "shard_errors",
+    "unavailable_replies",
+    "shed_relayed",
+    "deadline_exceeded",
+    "bad_requests",
+    "internal_errors",
+    "draining_replies",
+    "shard_down_windows",
+)
+
+
+class SieveRouter:
+    """The shard-fabric front door. See the module docstring."""
+
+    def __init__(
+        self,
+        shardmap: ShardMap,
+        settings: RouterSettings | None = None,
+        addr: str | None = None,
+        chaos_spec: str = "",
+    ):
+        self.map = shardmap
+        self.settings = (settings or RouterSettings()).validate()
+        self._addr_req = addr or "127.0.0.1:0"
+        # MetricsLogger only reads .quiet off its config; the router has
+        # no SieveConfig, so a minimal shim stands in
+        self.metrics = MetricsLogger(
+            types.SimpleNamespace(quiet=self.settings.quiet)
+        )
+        s = self.settings
+        self.sets = [
+            ReplicaSet(
+                sh.addrs,
+                timeout_s=s.timeout_s,
+                probe_timeout_s=s.probe_timeout_s,
+                rounds=s.rounds,
+                probe_ttl_s=s.probe_ttl_s,
+            )
+            for sh in shardmap
+        ]
+        self.chaos = ChaosSchedule(parse_chaos(chaos_spec))
+        # cumulative-totals cache: _totals[i] = primes in shard i's full
+        # declared range — an immutable fact, cached forever once known
+        self._totals: dict[int, int] = {}
+        self._totals_lock = threading.Lock()
+        # svc_shard_down windows: shard index -> monotonic expiry
+        self._down_until: dict[int, float] = {}
+        self._down_lock = threading.Lock()
+        self._stats = {k: 0 for k in _ROUTER_STATS}
+        self._stats_lock = threading.Lock()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self._listener: socket.socket | None = None
+        self._bound_addr: str | None = None
+        self._closing = False
+        self._draining = False
+        self._inflight_n = 0
+        self._inflight_lock = threading.Lock()
+        self.drain_event = threading.Event()
+        self._drained = threading.Event()
+
+    # --- lifecycle -------------------------------------------------------
+
+    @property
+    def addr(self) -> str:
+        if self._bound_addr is None:
+            raise RuntimeError("router not started")
+        return self._bound_addr
+
+    def start(self) -> "SieveRouter":
+        host, port = parse_addr(self._addr_req)
+        self._listener = socket.create_server((host, port))
+        self._listener.listen(64)
+        bhost, bport = self._listener.getsockname()[:2]
+        self._bound_addr = f"{bhost}:{bport}"
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="router-accept")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def drain(self) -> None:
+        """Stop accepting, shed new queries as typed ``draining``, let
+        in-flight scatters finish. Idempotent; SIGTERM and the wire
+        ``shutdown`` message both land here."""
+        if self._draining:
+            return
+        self._draining = True
+        if self._listener is not None:
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        self.metrics.event("router_drain", inflight=self._inflight_n)
+        self.drain_event.set()
+        self._maybe_drained()
+
+    def wait_drained(self, timeout: float | None = None) -> bool:
+        return self._drained.wait(timeout)
+
+    def _maybe_drained(self) -> None:
+        with self._inflight_lock:
+            done = self._draining and self._inflight_n == 0
+        if done:
+            self._drained.set()
+
+    def stop(self) -> None:
+        self._closing = True
+        if self._listener is not None:
+            # shutdown() before close(): a plain close does not wake a
+            # thread blocked in accept(), which would stall the join below
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+        for rs in self.sets:
+            rs.close()
+        self._drained.set()
+
+    def __enter__(self) -> "SieveRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # --- bookkeeping -----------------------------------------------------
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._stats[name] += n
+        registry().counter(f"router.{name}").inc(n)
+
+    def _next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def inject_chaos(self, spec: str) -> int:
+        ds = parse_chaos(spec)
+        self.chaos.extend(ds)
+        return len(ds)
+
+    # --- chaos & availability --------------------------------------------
+
+    def _draw_chaos(self, seq: int) -> None:
+        """Consume svc_shard_down directives for this request sequence.
+        The directive's worker field addresses a shard (ANY = every
+        shard); windows extend, never shrink."""
+        now = trace.now_s()
+        for i in range(len(self.map)):
+            for d in self.chaos.take_kinds(i, seq, ROUTER_REQUEST_KINDS):
+                secs = float(d.get("param") or 0.0)
+                targets = (range(len(self.map))
+                           if d.get("worker") == ANY_WORKER else (i,))
+                for t in targets:
+                    with self._down_lock:
+                        self._down_until[t] = max(
+                            self._down_until.get(t, 0.0), now + secs
+                        )
+                    self._bump("shard_down_windows")
+                    self.metrics.event(
+                        "router_shard_down", shard=t,
+                        reason=f"chaos svc_shard_down ({secs}s)",
+                    )
+
+    def _check_shard_up(self, i: int) -> None:
+        with self._down_lock:
+            until = self._down_until.get(i, 0.0)
+        if trace.now_s() < until:
+            sh = self.map.shards[i]
+            raise ShardUnavailable(
+                i, sh.lo, sh.hi,
+                "svc_shard_down window live "
+                f"({until - trace.now_s():.2f}s remaining)",
+            )
+
+    # --- downstream calls ------------------------------------------------
+
+    def _shard_query(self, i: int, op: str, deadline: float,
+                     rctx: _RouteCtx, **params: Any):
+        """One downstream call with deadline budgeting + typed relay.
+
+        Raises :class:`DeadlineExceeded` when the budget is spent,
+        :class:`ShardUnavailable` when the shard cannot answer at all,
+        and :class:`_Relay` for downstream typed errors."""
+        self._check_shard_up(i)
+        remaining = deadline - trace.now_s()
+        if remaining <= 0:
+            raise DeadlineExceeded(rctx.answered_hi, rctx.count_so_far)
+        rctx.shards.add(i)
+        sh = self.map.shards[i]
+        t0 = trace.now_s()
+        outcome = "ok"
+        try:
+            try:
+                reply = self.sets[i].query(op, deadline_s=remaining,
+                                           **params)
+            except (ServiceError, CallTimeout) as e:
+                # ReplicaSet exhaustion ("unavailable") or a poisoned
+                # call: the shard as a whole could not answer
+                outcome = "unavailable"
+                raise ShardUnavailable(i, sh.lo, sh.hi, str(e)) from None
+            if reply.get("ok"):
+                return reply["value"]
+            outcome = str(reply.get("error", "internal"))
+            raise _Relay(reply, i)
+        finally:
+            trace.add_span("route.scatter", t0, trace.now_s() - t0,
+                           shard=i, op=op, outcome=outcome)
+
+    def _shard_total(self, i: int, deadline: float, rctx: _RouteCtx) -> int:
+        """Primes in shard i's full declared range, cached forever."""
+        with self._totals_lock:
+            if i in self._totals:
+                return self._totals[i]
+        sh = self.map.shards[i]
+        total = self._shard_query(i, "count", deadline, rctx,
+                                  lo=sh.lo, hi=sh.hi)
+        with self._totals_lock:
+            self._totals[i] = int(total)
+        return int(total)
+
+    # --- routed ops ------------------------------------------------------
+
+    def _execute(self, op: str, msg: dict, deadline: float,
+                 rctx: _RouteCtx):
+        if op == "pi":
+            x = _req_int(msg, "x")
+            if x < 0 or x + 1 > MAX_HI:
+                raise BadRequest(f"pi({x}): x must be in [0, {MAX_HI})")
+            self._bump("scattered")
+            return self._count_primes(2, x + 1, deadline, rctx)
+        if op == "is_prime":
+            x = _req_int(msg, "x")
+            if x + 1 > MAX_HI:
+                raise BadRequest(f"is_prime({x}): x must be < {MAX_HI}")
+            if x < 2:
+                return False
+            self._bump("routed_point")
+            i = self.map.shard_for(x)
+            rctx.answered_hi = max(rctx.answered_hi, x)
+            return bool(self._shard_query(i, "is_prime", deadline, rctx,
+                                          x=x))
+        if op == "count":
+            lo, hi = _req_int(msg, "lo"), _req_int(msg, "hi")
+            kind = str(msg.get("kind", "primes"))
+            if hi > MAX_HI:
+                raise BadRequest(f"count: hi={hi} exceeds {MAX_HI}")
+            if hi < lo:
+                raise BadRequest(f"count: hi={hi} < lo={lo}")
+            self._bump("scattered")
+            if kind == "primes":
+                return self._count_primes(lo, hi, deadline, rctx)
+            if kind in _PAIR_GAP:
+                return self._count_pairs(lo, hi, kind, deadline, rctx)
+            raise BadRequest(
+                f"count: unknown kind {kind!r} (primes, twins, cousins)"
+            )
+        if op == "nth_prime":
+            k = _req_int(msg, "k")
+            if k < 1:
+                raise BadRequest(f"nth_prime({k}): k must be >= 1")
+            self._bump("routed_point")
+            return self._nth_prime(k, deadline, rctx)
+        if op == "primes":
+            lo, hi = _req_int(msg, "lo"), _req_int(msg, "hi")
+            if hi > MAX_HI:
+                raise BadRequest(f"primes: hi={hi} exceeds {MAX_HI}")
+            if hi < lo:
+                raise BadRequest(f"primes: hi={hi} < lo={lo}")
+            self._bump("scattered")
+            return self._primes(lo, hi, deadline, rctx)
+        raise BadRequest(
+            f"unknown op {op!r} (one of pi, is_prime, count, nth_prime, "
+            "primes)"
+        )
+
+    @staticmethod
+    def _partial(op: str, rctx: _RouteCtx) -> dict:
+        """Typed partial in the single server's key schema: the fabric
+        prefix [map.lo, answered_hi) is fully answered."""
+        if op == "pi":
+            return {"answered_hi": rctx.answered_hi,
+                    "pi_so_far": rctx.count_so_far}
+        if op == "nth_prime":
+            return {"searched_hi": rctx.answered_hi,
+                    "count_so_far": rctx.count_so_far}
+        return {"answered_hi": rctx.answered_hi,
+                "count_so_far": rctx.count_so_far}
+
+    def _fold_partial(self, e: _Relay, rctx: _RouteCtx) -> None:
+        """A downstream deadline partial is a contiguous prefix of ITS
+        shard window; since scatters run ascending, folding it into the
+        route context keeps the fabric-level prefix contiguous too."""
+        p = e.reply.get("partial") or {}
+        hi = p.get("answered_hi", p.get("searched_hi"))
+        if isinstance(hi, int):
+            rctx.answered_hi = max(rctx.answered_hi, hi)
+        c = p.get("count_so_far", p.get("pi_so_far"))
+        if isinstance(c, int):
+            rctx.count_so_far += c
+
+    def _count_primes(self, lo: int, hi: int, deadline: float,
+                      rctx: _RouteCtx) -> int:
+        lo = max(lo, 2)
+        if hi <= lo:
+            return 0
+        if lo < self.map.lo:
+            raise BadRequest(
+                f"count: lo={lo} below the fabric range "
+                f"[{self.map.lo}, ...)"
+            )
+        total = 0
+        for i, a, b in self.map.shards_in(lo, hi):
+            sh = self.map.shards[i]
+            if (a, b) == (sh.lo, sh.hi):
+                v = self._shard_total(i, deadline, rctx)
+            else:
+                v = self._shard_query(i, "count", deadline, rctx,
+                                      lo=a, hi=b)
+            total += int(v)
+            rctx.answered_hi = max(rctx.answered_hi, b)
+            rctx.count_so_far = total
+        return total
+
+    def _count_pairs(self, lo: int, hi: int, kind: str, deadline: float,
+                     rctx: _RouteCtx) -> int:
+        gap = _PAIR_GAP[kind]
+        lo = max(lo, 2)
+        if hi <= lo:
+            return 0
+        if lo < self.map.lo:
+            raise BadRequest(
+                f"count: lo={lo} below the fabric range "
+                f"[{self.map.lo}, ...)"
+            )
+        parts = self.map.shards_in(lo, hi)
+        total = 0
+        # pairs fully inside one shard window
+        for i, a, b in parts:
+            total += int(self._shard_query(i, "count", deadline, rctx,
+                                           lo=a, hi=b, kind=kind))
+        # splice each interior edge E: a straddling pair (p, p+gap) has
+        # p in [E-gap, E) on the left shard and p+gap in [E, E+gap) on
+        # the right — MIN_SPAN guarantees both windows stay inside their
+        # shard, so each downstream ask is range-legal
+        for (il, _al, bl), (ir, ar, _br) in zip(parts, parts[1:]):
+            edge = bl
+            assert edge == ar, "shards_in returned non-adjacent parts"
+            left_lo = max(lo, edge - gap)
+            right_hi = min(hi, edge + gap)
+            if left_lo >= edge or right_hi <= edge:
+                continue
+            left = self._shard_query(il, "primes", deadline, rctx,
+                                     lo=left_lo, hi=edge)
+            right = set(self._shard_query(ir, "primes", deadline, rctx,
+                                          lo=edge, hi=right_hi))
+            crossing = sum(1 for p in left if p + gap in right)
+            total += crossing
+            rctx.spliced += 1
+            self._bump("spliced")
+            self.metrics.event("router_spliced", quietable=True,
+                               edge=edge, pair_kind=kind, pairs=crossing)
+        return total
+
+    def _nth_prime(self, k: int, deadline: float, rctx: _RouteCtx) -> int:
+        cum = 0
+        last = len(self.map) - 1
+        for i in range(len(self.map)):
+            if i == last:
+                # the last shard extends past the map via its cold tier;
+                # whatever k remains, it owns the answer
+                return int(self._shard_query(i, "nth_prime", deadline,
+                                             rctx, k=k - cum))
+            total = self._shard_total(i, deadline, rctx)
+            if cum + total >= k:
+                return int(self._shard_query(i, "nth_prime", deadline,
+                                             rctx, k=k - cum))
+            cum += total
+            rctx.answered_hi = max(rctx.answered_hi,
+                                   self.map.shards[i].hi)
+            rctx.count_so_far = cum
+        raise AssertionError("unreachable: last shard handles any k")
+
+    def _primes(self, lo: int, hi: int, deadline: float,
+                rctx: _RouteCtx) -> list[int]:
+        lo = max(lo, 2)
+        if hi <= lo:
+            return []
+        if lo < self.map.lo:
+            raise BadRequest(
+                f"primes: lo={lo} below the fabric range "
+                f"[{self.map.lo}, ...)"
+            )
+        out: list[int] = []
+        for i, a, b in self.map.shards_in(lo, hi):
+            vals = self._shard_query(i, "primes", deadline, rctx,
+                                     lo=a, hi=b)
+            out.extend(int(p) for p in vals)
+            rctx.answered_hi = max(rctx.answered_hi, b)
+            rctx.count_so_far = len(out)
+        return out
+
+    # --- control plane ---------------------------------------------------
+
+    def health(self) -> dict:
+        """Aggregate health: per-shard depth/brownout/covered_hi plus the
+        fabric's contiguous covered range (covered_hi stops at the first
+        shard that is unreachable or still behind its declared range)."""
+        shards_out = []
+        covered_hi = self.map.lo
+        contiguous = True
+        degraded = False
+        now = trace.now_s()
+        for i, sh in enumerate(self.map.shards):
+            with self._down_lock:
+                held_down = now < self._down_until.get(i, 0.0)
+            ent: dict[str, Any] = {"shard": i, "lo": sh.lo, "hi": sh.hi}
+            if held_down:
+                ent["status"] = "unavailable"
+                ent["detail"] = "svc_shard_down window live"
+            else:
+                try:
+                    h = self.sets[i].health()
+                    ent["status"] = h.get("status", "ok")
+                    ent["covered_hi"] = h.get("covered_hi")
+                    ent["queue_depth"] = h.get("queue_depth")
+                    ent["brownout"] = h.get("brownout")
+                    ent["draining"] = h.get("draining")
+                    gauges = registry()
+                    gauges.gauge(f"router.shard{i}.queue_depth").set(
+                        float(h.get("queue_depth") or 0)
+                    )
+                    gauges.gauge(f"router.shard{i}.covered_hi").set(
+                        float(h.get("covered_hi") or 0)
+                    )
+                except ServiceError as e:
+                    ent["status"] = "unavailable"
+                    ent["detail"] = e.detail
+            if ent["status"] == "unavailable":
+                degraded = True
+                contiguous = False
+            elif contiguous:
+                # the fabric's contiguous covered range stops at the
+                # first shard whose index falls short of its slice; the
+                # last shard's cold-grown coverage extends past the map
+                sh_cov = int(ent.get("covered_hi") or sh.lo)
+                is_last = i == len(self.map) - 1
+                covered_hi = max(
+                    covered_hi, sh_cov if is_last else min(sh_cov, sh.hi)
+                )
+                if sh_cov < sh.hi:
+                    contiguous = False
+            if ent.get("status") == "degraded":
+                degraded = True
+            shards_out.append(ent)
+        return {
+            "type": "health", "ok": True,
+            "status": "degraded" if degraded else "ok",
+            "role": "router",
+            "shard_count": len(self.map),
+            "range_lo": self.map.lo,
+            "range_hi": self.map.hi,
+            "covered_hi": covered_hi,
+            "draining": self._draining,
+            "shards": shards_out,
+        }
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            out = dict(self._stats)
+        out["shard_count"] = len(self.map)
+        out["range_lo"] = self.map.lo
+        out["range_hi"] = self.map.hi
+        out["totals_cached"] = len(self._totals)
+        out["draining"] = self._draining
+        out["probes"] = sum(rs.probes for rs in self.sets)
+        out["failovers"] = sum(rs.failovers for rs in self.sets)
+        return out
+
+    # --- network plumbing ------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._closing:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._conns_lock:
+                self._conns.add(conn)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._closing:
+                try:
+                    msg = recv_msg(conn)
+                except (OSError, ValueError):
+                    return
+                if msg is None:
+                    return
+                self._dispatch(conn, send_lock, msg)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _reply(self, conn: socket.socket, send_lock: threading.Lock,
+               payload: dict) -> None:
+        try:
+            with send_lock:
+                send_msg(conn, payload)
+        except OSError:
+            pass
+
+    def _dispatch(self, conn, send_lock, msg: dict) -> None:
+        mtype = msg.get("type")
+        rid = msg.get("id")
+        if mtype == "health":
+            h = self.health()
+            h["id"] = rid
+            self._reply(conn, send_lock, h)
+            return
+        if mtype == "stats":
+            self._reply(conn, send_lock,
+                        {"type": "stats", "id": rid, "ok": True,
+                         "stats": self.stats()})
+            return
+        if mtype == "shutdown":
+            self._reply(conn, send_lock,
+                        {"type": "reply", "id": rid, "ok": True,
+                         "draining": True})
+            self.drain()
+            return
+        if mtype == "chaos":
+            if not self.settings.wire_chaos:
+                self.metrics.event("router_chaos_refused",
+                                   spec=str(msg.get("spec", "")))
+                self._reply(conn, send_lock, {
+                    "type": "reply", "id": rid, "ok": False,
+                    "error": "bad_request",
+                    "detail": "wire chaos injection is disabled on this "
+                              "router (start it with --allow-chaos)",
+                })
+                return
+            try:
+                n = self.inject_chaos(str(msg.get("spec", "")))
+            except ValueError as e:
+                self._reply(conn, send_lock,
+                            {"type": "reply", "id": rid, "ok": False,
+                             "error": "bad_request", "detail": str(e)})
+                return
+            self._reply(conn, send_lock,
+                        {"type": "reply", "id": rid, "ok": True,
+                         "injected": n})
+            return
+        if mtype != "query":
+            self._reply(conn, send_lock, {
+                "type": "reply", "id": rid, "ok": False,
+                "error": "bad_request",
+                "detail": f"unknown message type {mtype!r}",
+            })
+            return
+        self._handle_query(conn, send_lock, msg, rid)
+
+    def _handle_query(self, conn, send_lock, msg: dict, rid) -> None:
+        with self._inflight_lock:
+            self._inflight_n += 1
+        try:
+            self._handle_query_inner(conn, send_lock, msg, rid)
+        finally:
+            with self._inflight_lock:
+                self._inflight_n -= 1
+            self._maybe_drained()
+
+    def _handle_query_inner(self, conn, send_lock, msg: dict, rid) -> None:
+        op = str(msg.get("op", ""))
+        t0 = trace.now_s()
+        seq = self._next_seq()
+        self._bump("requests")
+        self._draw_chaos(seq)
+        rctx = _RouteCtx()
+        outcome = "ok"
+        reply: dict = {"type": "reply", "id": rid, "ok": True, "op": op}
+        try:
+            if self._draining:
+                raise Draining("router is draining; new queries are shed")
+            raw = msg.get("deadline_s")
+            if raw is not None and (
+                not isinstance(raw, (int, float)) or isinstance(raw, bool)
+                or raw <= 0 or not math.isfinite(raw)
+            ):
+                raise BadRequest(
+                    f"deadline_s must be a positive number, got {raw!r}"
+                )
+            deadline = t0 + float(raw or self.settings.default_deadline_s)
+            reply["value"] = self._execute(op, msg, deadline, rctx)
+        except _Relay as e:
+            down = e.reply
+            outcome = str(down.get("error", "internal"))
+            if outcome not in _RELAY_KINDS:
+                outcome = "internal"
+            self._bump("shard_errors")
+            if outcome == "deadline_exceeded":
+                # fold the shard's contiguous partial into the route's:
+                # scatters run ascending, so the fabric-level prefix
+                # [2, answered_hi) stays contiguous
+                self._fold_partial(e, rctx)
+                self._bump("deadline_exceeded")
+                reply = {
+                    "type": "reply", "id": rid, "ok": False, "op": op,
+                    "error": "deadline_exceeded",
+                    "detail": down.get("detail", ""),
+                    "partial": self._partial(op, rctx),
+                    "shard": e.shard,
+                }
+            else:
+                # forwarded verbatim + shard tag (lane rides along on
+                # an overloaded shed — lane-aware propagation)
+                reply = {
+                    "type": "reply", "id": rid, "ok": False, "op": op,
+                    "error": outcome,
+                    "detail": down.get("detail", ""),
+                    "partial": down.get("partial"),
+                    "shard": e.shard,
+                }
+                if "lane" in down:
+                    reply["lane"] = down["lane"]
+                if outcome in ("overloaded", "degraded", "draining"):
+                    self._bump("shed_relayed")
+        except ShardUnavailable as e:
+            outcome = "unavailable"
+            reply = {
+                "type": "reply", "id": rid, "ok": False, "op": op,
+                "error": "unavailable", "detail": str(e),
+                "partial": None, "shard": e.shard,
+                "shard_range": [e.lo, e.hi],
+            }
+            self._bump("shard_errors")
+            self._bump("unavailable_replies")
+            self.metrics.event("router_shard_down", shard=e.shard,
+                               reason=e.reason)
+        except DeadlineExceeded as e:
+            outcome = "deadline_exceeded"
+            rctx.answered_hi = max(rctx.answered_hi, e.answered_hi)
+            rctx.count_so_far = max(rctx.count_so_far, e.count_so_far)
+            reply = {
+                "type": "reply", "id": rid, "ok": False, "op": op,
+                "error": "deadline_exceeded", "detail": str(e),
+                "partial": self._partial(op, rctx),
+            }
+            self._bump("deadline_exceeded")
+        except BadRequest as e:
+            outcome = "bad_request"
+            reply = {
+                "type": "reply", "id": rid, "ok": False, "op": op,
+                "error": "bad_request", "detail": str(e), "partial": None,
+            }
+            self._bump("bad_requests")
+        except Draining as e:
+            outcome = "draining"
+            reply = {
+                "type": "reply", "id": rid, "ok": False, "op": op,
+                "error": "draining", "detail": str(e), "partial": None,
+            }
+            self._bump("draining_replies")
+        except Exception as e:  # noqa: BLE001 — router must not die
+            outcome = "internal"
+            reply = {
+                "type": "reply", "id": rid, "ok": False, "op": op,
+                "error": "internal",
+                "detail": f"{type(e).__name__}: {e}", "partial": None,
+            }
+            self._bump("internal_errors")
+        t_end = trace.now_s()
+        reply.setdefault("source", "router")
+        reply["elapsed_ms"] = round((t_end - t0) * 1000, 3)
+        trace.add_span("rpc.route", t0, t_end - t0, op=op, outcome=outcome,
+                       shards=len(rctx.shards))
+        self.metrics.event(
+            "router_request", quietable=True, op=op, outcome=outcome,
+            shards=len(rctx.shards), ms=reply["elapsed_ms"],
+        )
+        self._reply(conn, send_lock, reply)
+
+
+def _req_int(msg: dict, field: str) -> int:
+    v = msg.get(field)
+    if not isinstance(v, int) or isinstance(v, bool):
+        raise BadRequest(f"field {field!r} must be an integer, got {v!r}")
+    return v

@@ -216,6 +216,12 @@ class ServiceSettings:
     cold_queue_limit: int | None = None
     hot_workers: int = 1
     cold_age_s: float = 1.0
+    # range sharding (ISSUE 11): anchor this server's served range at a
+    # shard lower bound instead of 2. Counts become "primes in
+    # [range_lo, v)", nth_prime becomes "k-th prime >= range_lo", and
+    # queries below range_lo are typed bad_request naming the range —
+    # global-semantics composition is the router's job, never a shard's.
+    range_lo: int = 2
 
     def validate(self) -> "ServiceSettings":
         """Typed startup validation: every rejection names the setting
@@ -263,6 +269,12 @@ class ServiceSettings:
                 "service settings: default_deadline_s="
                 f"{self.default_deadline_s!r} must be a positive number"
             )
+        if (not isinstance(self.range_lo, int)
+                or isinstance(self.range_lo, bool) or self.range_lo < 2):
+            raise ValueError(
+                f"service settings: range_lo={self.range_lo!r} must be an "
+                "integer >= 2"
+            )
         return self
 
     @classmethod
@@ -304,6 +316,7 @@ class ServiceSettings:
             ),
             hot_workers=_env_int("SIEVE_SVC_HOT_WORKERS", cls.hot_workers),
             cold_age_s=_env_float("SIEVE_SVC_COLD_AGE_S", cls.cold_age_s),
+            range_lo=_env_int("SIEVE_SVC_RANGE_LO", cls.range_lo),
         )
         return dataclasses.replace(s, **overrides)
 
@@ -667,7 +680,7 @@ class LedgerFollower:
         old = svc.index
         new = SieveIndex(
             svc.config.packing, led.completed(),
-            svc.settings.lru_segments, lru=old.lru,
+            svc.settings.lru_segments, lru=old.lru, base=old.base,
         )
         if new.covered_hi < old.covered_hi:
             self._failed(
@@ -754,8 +767,13 @@ class SieveService:
         if config.checkpoint_dir:
             self.ledger = self._open_snapshot()
             entries = self.ledger.completed()
+        # range sharding (ISSUE 11): the index anchors its contiguous
+        # prefix at range_lo, so this server natively speaks shard-local
+        # semantics (counts from range_lo, nth >= range_lo)
+        self.base = self.settings.range_lo
         self.index = SieveIndex(
-            config.packing, entries, self.settings.lru_segments
+            config.packing, entries, self.settings.lru_segments,
+            base=self.base,
         )
         registry().gauge("cluster.covered_hi").set(
             float(self.index.covered_hi)
@@ -915,6 +933,12 @@ class SieveService:
         if self.follower is not None:
             self.follower.stop()
         if self._listener is not None:
+            # shutdown() before close(): a plain close does not wake a
+            # thread blocked in accept(), which would stall the join below
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:
@@ -1044,6 +1068,7 @@ class SieveService:
         out["snapshot_age_s"] = round(trace.now_s() - self._snapshot_ts, 3)
         out["draining"] = self._draining
         out["persist_cold"] = self._writer is not None
+        out["range_lo"] = self.base
         return out
 
     def _on_degraded(self, entering: bool, reason: str) -> None:
@@ -1125,6 +1150,7 @@ class SieveService:
                 ),
                 "refreshes": self._refreshes,
                 "draining": self._draining,
+                "range_lo": self.base,
             })
             return None
         if mtype == "stats":
@@ -1287,6 +1313,9 @@ class SieveService:
         try:
             if op == "pi":
                 return self._lane_for_prefixes([int(msg["x"]) + 1], idx)
+            if op == "is_prime":
+                x = int(msg["x"])
+                return self._lane_for_prefixes([x, x + 1], idx)
             if op == "count":
                 lo, hi = int(msg["lo"]), int(msg["hi"])
                 if hi < lo or hi > MAX_HI:
@@ -1467,32 +1496,63 @@ class SieveService:
     def _execute(self, op: str, msg: dict, ctx: QueryCtx, deadline: float,
                  idx: SieveIndex):
         if op == "pi":
+            if self.base > 2:
+                # a shard-local prefix count is NOT pi: refusing here is
+                # what lets the router compose exact global answers
+                raise BadRequest(
+                    f"pi is a global-prefix op; this server serves "
+                    f"[{self.base}, ...) — use count(lo, hi) or query "
+                    "the router"
+                )
             x = _req_int(msg, "x")
             if x < 0 or x + 1 > MAX_HI:
                 raise BadRequest(f"pi({x}): x must be in [0, {MAX_HI})")
             return self._count_upto(x + 1, ctx, deadline, idx)
+        if op == "is_prime":
+            x = _req_int(msg, "x")
+            if x + 1 > MAX_HI:
+                raise BadRequest(f"is_prime({x}): x must be < {MAX_HI}")
+            if x < 2:
+                return False
+            self._check_base(op, x)
+            lo_c = self._count_upto(x, ctx, deadline, idx)
+            return self._count_upto(x + 1, ctx, deadline, idx) - lo_c > 0
         if op == "count":
             lo, hi = _req_int(msg, "lo"), _req_int(msg, "hi")
+            if hi > lo:
+                self._check_base(op, lo)
             kind = str(msg.get("kind", "primes"))
             return self._count(lo, hi, kind, ctx, deadline, idx)
         if op == "nth_prime":
             return self._nth_prime(_req_int(msg, "k"), ctx, deadline, idx)
         if op == "primes":
             lo, hi = _req_int(msg, "lo"), _req_int(msg, "hi")
+            if hi > lo:
+                self._check_base(op, lo)
             return self._primes(lo, hi, ctx, deadline, idx)
         raise BadRequest(
-            f"unknown op {op!r} (one of pi, count, nth_prime, primes)"
+            f"unknown op {op!r} (one of pi, is_prime, count, nth_prime, "
+            "primes)"
         )
+
+    def _check_base(self, op: str, lo: int) -> None:
+        """Range-sharded servers reject queries below their shard."""
+        if self.base > 2 and lo < self.base:
+            raise BadRequest(
+                f"{op}: lo={lo} below this server's range "
+                f"[{self.base}, ...) (range_lo={self.base})"
+            )
 
     def _count_upto(self, v: int, ctx: QueryCtx, deadline: float,
                     idx: SieveIndex) -> int:
-        """Primes in [2, v): index prefix + cold chunks past covered_hi.
+        """Primes in [base, v): index prefix + cold chunks past covered_hi
+        (base is 2 on a whole-range server, range_lo on a shard).
 
         The WHOLE cold chunk list is computed up front and submitted to
         the batcher in one go (ISSUE 9) — a request spanning K chunks
         registers all K flights before the first wait, so one queue
         drain sees them together and one backend dispatch answers them."""
-        if v <= 2:
+        if v <= self.base:
             return 0
         covered = min(v, idx.covered_hi)
         total = idx.count_upto(covered, ctx)

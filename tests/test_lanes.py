@@ -156,7 +156,7 @@ def test_health_and_stats_key_schema_snapshot(service):
     assert cli.pi(30_000) == o_pi(30_000)
     assert sorted(cli.health()) == [
         "brownout", "covered_hi", "draining", "id", "ok", "queue_depth",
-        "queue_depth_cold", "queue_depth_hot", "refreshes",
+        "queue_depth_cold", "queue_depth_hot", "range_lo", "refreshes",
         "snapshot_age_s", "status", "total_primes", "type",
     ]
     assert sorted(cli.stats()) == [
@@ -168,7 +168,7 @@ def test_health_and_stats_key_schema_snapshot(service):
         "hot_admitted", "hot_workers_dedicated", "index_hits",
         "internal_errors", "lane_shed_cold", "lane_shed_hot",
         "lru_entries", "lru_hits", "materialized", "persist_cold",
-        "queue_depth", "queue_depth_cold", "queue_depth_hot",
+        "queue_depth", "queue_depth_cold", "queue_depth_hot", "range_lo",
         "refresh_attempts", "refresh_failed", "refreshes", "requests",
         "segments", "shed", "snapshot_age_s", "total_primes",
     ]

@@ -126,6 +126,11 @@ def report(spans: list[dict], top: int = 10) -> str:
         lines.append("")
         lines.extend(service)
 
+    router = router_report(spans)
+    if router:
+        lines.append("")
+        lines.extend(router)
+
     lines.append("")
     lines.append(f"slowest {min(top, len(spans))} spans:")
     for e in sorted(spans, key=lambda e: -e["dur"])[:top]:
@@ -250,6 +255,73 @@ def service_report(spans: list[dict]) -> list[str]:
                 f"  {lane:<6} {len(durs):>6} "
                 f"{sum(durs) / len(durs) / 1e3:>9.3f} {p95 / 1e3:>9.3f} "
                 f"{max(durs) / 1e3:>9.3f} {wp95 / 1e3:>12.3f}"
+            )
+    return lines
+
+
+def router_report(spans: list[dict]) -> list[str]:
+    """Shard-router section (ISSUE 11): front-door latency by op and
+    outcome (``rpc.route`` spans) plus the per-shard scatter table
+    (``route.scatter`` spans — one per downstream shard call, so a
+    scatter-gather query contributes a row to several shards). Traces
+    from pre-router runs have no rpc.route spans and skip the block."""
+    route = [e for e in spans if e["name"] == "rpc.route"]
+    if not route:
+        return []
+    lines = ["shard router (rpc.route requests):"]
+    by_key: dict[tuple[str, str], list[float]] = {}
+    fanout = 0
+    for e in route:
+        a = e.get("args", {})
+        by_key.setdefault(
+            (str(a.get("op", "?")), str(a.get("outcome", "?"))), []
+        ).append(e["dur"])
+        fanout += int(a.get("shards", 0) or 0)
+    lines.append(
+        f"  {len(route)} routed requests, "
+        f"{fanout / len(route):.2f} shards touched per request"
+    )
+    lines.append(
+        f"  {'op':<10} {'outcome':<18} {'count':>6} {'total ms':>10} "
+        f"{'mean ms':>9} {'max ms':>9}"
+    )
+    for (op, outcome), durs in sorted(
+        by_key.items(), key=lambda kv: -sum(kv[1])
+    ):
+        lines.append(
+            f"  {op:<10} {outcome:<18} {len(durs):>6} "
+            f"{sum(durs) / 1e3:>10.3f} {sum(durs) / len(durs) / 1e3:>9.3f} "
+            f"{max(durs) / 1e3:>9.3f}"
+        )
+    scatter = [e for e in spans if e["name"] == "route.scatter"]
+    if scatter:
+        by_shard: dict[str, dict] = {}
+        for e in scatter:
+            a = e.get("args", {})
+            row = by_shard.setdefault(
+                str(a.get("shard", "?")), {"durs": [], "outcomes": {}}
+            )
+            row["durs"].append(e["dur"])
+            o = str(a.get("outcome", "?"))
+            row["outcomes"][o] = row["outcomes"].get(o, 0) + 1
+        lines.append(
+            f"  per-shard scatter ({len(scatter)} downstream calls):"
+        )
+        lines.append(
+            f"  {'shard':<6} {'calls':>6} {'mean ms':>9} {'p95 ms':>9} "
+            f"{'max ms':>9}  outcomes"
+        )
+        for shard in sorted(by_shard, key=lambda s: (len(s), s)):
+            row = by_shard[shard]
+            durs = sorted(row["durs"])
+            p95 = durs[max(0, math.ceil(0.95 * len(durs)) - 1)]
+            outs = " ".join(
+                f"{k}={v}" for k, v in sorted(row["outcomes"].items())
+            )
+            lines.append(
+                f"  {shard:<6} {len(durs):>6} "
+                f"{sum(durs) / len(durs) / 1e3:>9.3f} {p95 / 1e3:>9.3f} "
+                f"{max(durs) / 1e3:>9.3f}  {outs}"
             )
     return lines
 
