@@ -2,7 +2,7 @@
 host-prepare pipeline, the fused-reduction bandwidth model, and the
 query-service latency profile.
 
-Prints SEVEN JSON lines {"metric", "value", "unit", "vs_baseline"}:
+Prints EIGHT JSON lines {"metric", "value", "unit", "vs_baseline"}:
 
 1. pi(1e9), odds packing, tpu-pallas backend — the shallow regime.
    Baseline: BASELINE.md's measured CPU floor — pi(1e9) segmented numpy
@@ -48,6 +48,13 @@ Prints SEVEN JSON lines {"metric", "value", "unit", "vs_baseline"}:
    twin windows straddling the shard edge (the splice path). Unit
    ``ms_p95`` (same upward gate); vs_baseline = 50 ms budget / p95.
    Host-only: emitted anywhere.
+8. Fleet-tracing overhead (ISSUE 12): client-observed p95 of the line-5
+   mixed workload with the full trace plane on (span capture, bounded
+   ship ring, reply piggybacks) divided by the same workload's p95 with
+   it off. Unit ``overhead_ratio`` — gated ABSOLUTELY by
+   tools/bench_compare.py: a value > 1.05 (tracing costs more than 5%
+   of p95) fails regardless of the previous round. vs_baseline =
+   1.05 / ratio, so >= 1 is within budget. Host-only: emitted anywhere.
 
 Exact parity is asserted before any number is printed — the depth line
 against a cpu-numpy run of the same segment: a fast wrong sieve scores
@@ -616,6 +623,122 @@ def router_query_latency_metric() -> None:
     )
 
 
+def service_trace_overhead_metric() -> None:
+    """Fleet-tracing overhead (ISSUE 12): the line-5 mixed workload —
+    hot prefix counts, windowed counts, and genuinely cold chunks, same
+    shape and same cold behavior as ``service_query_latency_p95_ms`` —
+    run against fresh in-process services with the trace plane fully
+    off vs fully on (span capture + bounded ship ring + batched reply
+    piggybacks), timed from the CLIENT side so the ratio includes the
+    serialize/ship cost a server-side span would hide. The passes are
+    INTERLEAVED (off, on, off, on, ...); each begins with a short
+    untimed hot warmup (thread-name metadata, first counter-window
+    samples, and allocator transients must not land in the timed tail),
+    and the reported p95 per mode is the MINIMUM across reps — the
+    converged noise floor, which still contains every deterministic
+    per-request tracing cost. Every reply is asserted exact; every pass
+    gets a fresh service (cold LRU), so cold chunks cost the same in
+    both modes."""
+    import tempfile
+
+    import numpy as np
+
+    from sieve import trace
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+    from sieve.seed import seed_primes
+    from sieve.service import ServiceClient, ServiceSettings, SieveService
+
+    n = 2_000_000
+    chunk = 1 << 18
+    reps = 25
+    oracle = seed_primes(n + 9 * chunk)
+
+    def o_pi(x: int) -> int:
+        return int(np.searchsorted(oracle, x, side="right"))
+
+    def workload(cli: ServiceClient, timings: list[float]) -> None:
+        def timed(fn, *a):
+            t0 = time.perf_counter()
+            out = fn(*a)
+            timings.append((time.perf_counter() - t0) * 1e3)
+            return out
+
+        for i in range(150):  # hot: prefix counts
+            x = (7919 * (i + 1)) % n
+            assert timed(cli.pi, x) == o_pi(x), f"pi({x}) parity failure"
+        for i in range(50):   # hot: windowed counts (materialize tier)
+            lo = (104_729 * (i + 1)) % (n - 60_000)
+            want = o_pi(lo + 50_000 - 1) - o_pi(lo - 1)
+            assert timed(cli.count, lo, lo + 50_000) == want, \
+                f"count({lo}) parity failure"
+        for i in range(8):    # cold: one fresh chunk each, batched
+            x = n + (i + 1) * chunk - 1
+            assert timed(cli.pi, x) == o_pi(x), f"cold pi({x}) parity"
+
+    with tempfile.TemporaryDirectory(prefix="sieve_bench_trace") as ck:
+        cfg = SieveConfig(
+            n=n, backend="cpu-numpy", packing="odds", n_segments=8,
+            checkpoint_dir=ck, quiet=True,
+        )
+        run_local(cfg)
+
+        def run_pass(traced: bool) -> list[float]:
+            settings = ServiceSettings(
+                workers=4, queue_limit=64, cold_chunk=chunk,
+                refresh_s=0.0, telemetry_ship=traced,
+            )
+            if traced:
+                trace.enable()
+            with SieveService(cfg, settings) as svc, \
+                    ServiceClient(svc.addr, timeout_s=60) as cli:
+                timings: list[float] = []
+                if traced:
+                    # ask for the piggyback like a tracing router would
+                    orig = cli.query
+                    cli.query = (  # type: ignore[method-assign]
+                        lambda op, deadline_s=None, **p:
+                        orig(op, deadline_s, telemetry=True, **p)
+                    )
+                for i in range(30):  # untimed warmup: steady state only
+                    cli.pi((101 * (i + 1)) % n)
+                workload(cli, timings)
+            if traced:
+                trace.drain_events()
+                trace.disable()
+                trace.set_event_limit(None)
+            return timings
+
+        p95s_off: list[float] = []
+        p95s_on: list[float] = []
+        n_reqs = 0
+        for _ in range(reps):
+            off = run_pass(traced=False)
+            on = run_pass(traced=True)
+            p95s_off.append(_pctile(off, 0.95))
+            p95s_on.append(_pctile(on, 0.95))
+            n_reqs = len(on)
+    # min across reps per mode: the converged per-pass-p95 floor
+    p95_off = min(p95s_off)
+    p95_on = min(p95s_on)
+    ratio = p95_on / p95_off if p95_off else float("inf")
+    budget = 1.05
+    print(
+        json.dumps(
+            {
+                "metric": "service_trace_overhead_ratio",
+                "value": round(ratio, 4),
+                "unit": "overhead_ratio",
+                "vs_baseline": round(budget / ratio, 3) if ratio else None,
+                "p95_untraced_ms": round(p95_off, 3),
+                "p95_traced_ms": round(p95_on, 3),
+                "n": n_reqs,
+                "reps": reps,
+            }
+        )
+    )
+
+
 def main() -> int:
     shallow_metric()
     depth_metric()
@@ -624,6 +747,7 @@ def main() -> int:
     service_latency_metric()
     service_hot_under_flood_metric()
     router_query_latency_metric()
+    service_trace_overhead_metric()
     return 0
 
 

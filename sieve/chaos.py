@@ -94,6 +94,17 @@ shard):
                               exact — the whole-shard-outage drill
                               without killing real replicas.
 
+Telemetry plane (ISSUE 12; drawn by the shard server on its request
+sequence like the other ``svc_*`` request kinds):
+
+* ``svc_trace_drop:any@sK``   request K's terminal reply carries no
+                              piggybacked trace telemetry (the payload
+                              is dropped as if lost in transit) while
+                              the query result itself stays exact — the
+                              router must degrade to uncorrelated spans
+                              with a counted ``router_trace_gap`` event,
+                              never an error.
+
 ``worker`` is an integer id, or ``any``/``*`` for whichever worker draws
 the segment (the pull model makes a specific id probabilistic, ``any``
 deterministic). Directives are transported to the worker inside the
@@ -127,6 +138,7 @@ KINDS = (
     "svc_batch_partial",
     "svc_flood",
     "svc_shard_down",
+    "svc_trace_drop",
 )
 # kinds handled by the query service (sieve/service/); the cluster plane
 # ignores these and vice versa. Request-scoped kinds key on the request
@@ -143,6 +155,7 @@ SERVICE_KINDS = (
     "svc_drain",
     "svc_batch_partial",
     "svc_flood",
+    "svc_trace_drop",
 )
 SERVICE_REQUEST_KINDS = (
     "svc_stall",
@@ -151,6 +164,7 @@ SERVICE_REQUEST_KINDS = (
     "replica_down",
     "svc_drain",
     "svc_flood",
+    "svc_trace_drop",
 )
 # drawn by the router tier (ISSUE 11) on ITS request sequence; the
 # directive's worker field names a shard index there, so shard servers
@@ -178,6 +192,7 @@ DEFAULT_PARAM: dict[str, float | str | None] = {
     "svc_flood": "cold",
     # param = seconds the shard stays unreachable to the router
     "svc_shard_down": 1.0,
+    "svc_trace_drop": None,
 }
 
 

@@ -343,44 +343,10 @@ def _worker_backend() -> str:
 # --- coordinator role --------------------------------------------------------
 
 
-class _ClockAlign:
-    """Per-worker clock-offset estimate from RPC timestamp pairs.
-
-    NTP-style: a pair (assign -> heartbeat/reply) gives
-    ``rtt = (t_done - t_send) - (t_remote_send - t_remote_recv)`` and
-    ``offset = ((t_remote_recv - t_send) + (t_remote_send - t_done)) / 2``
-    with ``worker_clock ≈ coordinator_clock + offset``. The estimate kept
-    is the one from the lowest-RTT sample seen so far (ties refresh to the
-    newest, so equal-quality samples track slow drift); its error is
-    bounded by RTT/2 plus any send/receive asymmetry.
-    """
-
-    __slots__ = ("offset_s", "rtt_s", "samples")
-
-    def __init__(self) -> None:
-        self.offset_s = 0.0
-        self.rtt_s = float("inf")
-        self.samples = 0
-
-    def sample(
-        self,
-        t_send: float,
-        t_remote_recv: float,
-        t_remote_send: float,
-        t_done: float,
-    ) -> None:
-        rtt = max(0.0, (t_done - t_send) - (t_remote_send - t_remote_recv))
-        self.samples += 1
-        if rtt <= self.rtt_s:
-            self.rtt_s = rtt
-            self.offset_s = (
-                (t_remote_recv - t_send) + (t_remote_send - t_done)
-            ) / 2
-
-    @property
-    def err_s(self) -> float:
-        """Alignment-error bound for the kept sample (RTT/2)."""
-        return self.rtt_s / 2 if self.samples else float("inf")
+# Per-worker clock-offset estimation moved to trace.ClockAlign so the
+# service router (sieve/service/router.py) shares the same estimator;
+# kept under the old name for callers and tests.
+_ClockAlign = trace.ClockAlign
 
 
 class _WorkerConn(threading.Thread):

@@ -108,6 +108,18 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "router_spliced": {"edge", "pair_kind", "pairs"},
     "router_drain": {"inflight"},
     "router_chaos_refused": {"spec"},
+    # fleet trace/telemetry plane (ISSUE 12): service_trace_drop marks a
+    # reply whose piggybacked telemetry was chaos-dropped (query result
+    # still exact); router_trace_gap the router-side degradation for a
+    # reply that should have carried telemetry but didn't ("reason"
+    # dropped/malformed); router_telemetry one merged shard-replica span
+    # batch (rebased onto the router timeline — the service analogue of
+    # worker_telemetry); service_slo_burn the transition of one op's
+    # rolling p95 above its configured SLO.
+    "service_trace_drop": {"op"},
+    "router_trace_gap": {"shard", "reason"},
+    "router_telemetry": {"shard", "replica", "events", "dropped"},
+    "service_slo_burn": {"op", "p95_ms", "slo_ms", "window"},
 }
 
 

@@ -26,6 +26,19 @@ replica, while ``bad_request`` and ``deadline_exceeded`` never retry
 the caller bounded). Exhausting every replica across all rounds raises
 the last typed error seen, else ``ServiceError("unavailable")`` — the
 set never invents an answer.
+
+Every query is stamped with a trace context (ISSUE 12):
+``ctx = "<run_id>/<seq>.<attempt>"`` plus a ``t_send`` timestamp on the
+sender's trace epoch. The server echoes the ctx into its spans (so a
+routed query's shard-side ``rpc.query`` correlates with the router's
+``rpc.route``) and echoes receive/send timestamps for NTP-style clock
+alignment. A :class:`ReplicaSet` mints a FRESH attempt suffix per try —
+two attempts of one logical query are two distinct contexts, so a
+retried request never aliases spans from the attempt that failed. With
+``telemetry=True`` the reply may piggyback the replica's bounded span
+ring (the router asks for this when tracing); the set annotates each
+returned reply with a ``probe`` record (addr + its own send/done
+timestamps) so the caller can feed the clock aligner.
 """
 
 from __future__ import annotations
@@ -35,8 +48,10 @@ import random
 import socket
 import threading
 import time
+import uuid
 from typing import Any, Sequence
 
+from sieve import trace
 from sieve.metrics import registry
 from sieve.rpc import parse_addr, recv_msg, send_msg
 
@@ -68,6 +83,8 @@ class ServiceClient:
         host, port = parse_addr(addr)
         self._sock = socket.create_connection((host, port), timeout=timeout_s)
         self._ids = itertools.count(1)
+        self._run_id = uuid.uuid4().hex[:8]
+        self._ctx_seq = itertools.count(1)
         self._dead = False
 
     def close(self) -> None:
@@ -113,6 +130,10 @@ class ServiceClient:
         msg: dict[str, Any] = {"type": "query", "op": op, **params}
         if deadline_s is not None:
             msg["deadline_s"] = deadline_s
+        # trace ctx (ISSUE 12): a caller-supplied ctx (the router
+        # forwarding its route context) wins; a bare client is attempt 0
+        msg.setdefault("ctx", f"{self._run_id}/{next(self._ctx_seq)}.0")
+        msg.setdefault("t_send", round(trace.now_s(), 6))
         return self._call(msg)
 
     def _value(self, reply: dict):
@@ -157,6 +178,10 @@ class ServiceClient:
     def shutdown(self) -> dict:
         """Ask the server to drain (the wire twin of SIGTERM)."""
         return self._call({"type": "shutdown"})
+
+    def metrics(self) -> dict:
+        """Full metrics-registry snapshot (ISSUE 12 live telemetry op)."""
+        return self._call({"type": "metrics"})["metrics"]
 
     def inject_chaos(self, spec: str) -> dict:
         return self._call({"type": "chaos", "spec": spec})
@@ -225,6 +250,8 @@ class ReplicaSet:
         self.probe_ttl_s = probe_ttl_s
         self._lock = threading.Lock()
         self._rr = 0
+        self._run_id = uuid.uuid4().hex[:8]
+        self._ctx_seq = itertools.count(1)
         # observability for tools/tests: how often selection failed over
         self.failovers = 0
         self.probes = 0
@@ -313,17 +340,27 @@ class ReplicaSet:
 
     # --- calls ------------------------------------------------------------
 
-    def query(self, op: str, deadline_s: float | None = None,
+    def query(self, op: str, deadline_s: float | None = None, *,
+              ctx: str | None = None, telemetry: bool = False,
               **params: Any) -> dict:
         """One query with failover; returns the raw reply dict. Raises
         ConnectionError-shaped failures only as a final
         ``ServiceError("unavailable")`` after every replica and round is
-        exhausted; a non-failover typed error returns immediately."""
+        exhausted; a non-failover typed error returns immediately.
+
+        ``ctx`` is the trace-context BASE (``run_id/<seq>``, minted here
+        when absent — the router passes its route context down); each
+        try gets a fresh ``.{try}`` attempt suffix so retried requests
+        never alias spans. ``telemetry=True`` asks the replica to
+        piggyback its span ring on the reply."""
         msg: dict[str, Any] = {"type": "query", "op": op, **params}
         if deadline_s is not None:
             msg["deadline_s"] = deadline_s
+        if ctx is None:
+            ctx = f"{self._run_id}/{next(self._ctx_seq)}"
         last_typed: dict | None = None
         last_err: Exception | None = None
+        tries = 0
         for attempt in range(1, self.rounds + 1):
             for i, rep in enumerate(self._candidates()):
                 if i > 0:
@@ -333,8 +370,16 @@ class ReplicaSet:
                     with rep.lock:
                         client = self._ensure_client(rep)
                         # fresh copy per attempt: ids are per-connection,
-                        # and a retried dict must not pin a stale one
-                        reply = client._call(dict(msg))
+                        # a retried dict must not pin a stale one, and
+                        # the trace ctx names THIS attempt
+                        attempt_msg = dict(msg)
+                        attempt_msg["ctx"] = f"{ctx}.{tries}"
+                        t_send = round(trace.now_s(), 6)
+                        attempt_msg["t_send"] = t_send
+                        if telemetry:
+                            attempt_msg["telemetry"] = True
+                        tries += 1
+                        reply = client._call(attempt_msg)
                 except (ConnectionError, OSError, CallTimeout) as e:
                     self._mark_down(rep)
                     last_err = e
@@ -344,6 +389,13 @@ class ReplicaSet:
                     last_typed = {"ok": False, "error": e.kind,
                                   "detail": e.detail, "op": op}
                     continue
+                # clock-probe annotation for the caller's aligner: which
+                # replica answered, bracketed by our send/done timestamps
+                reply["probe"] = {
+                    "addr": rep.addr,
+                    "t_send": t_send,
+                    "t_done": round(trace.now_s(), 6),
+                }
                 self._mark_up(rep)
                 if reply.get("ok") or reply.get("error") not in FAILOVER_KINDS:
                     return reply
@@ -387,6 +439,59 @@ class ReplicaSet:
             f"no replica health over {len(self._replicas)} replicas "
             f"(last: {last_err!r})",
         )
+
+    def metrics(self) -> dict:
+        """Metrics snapshot of the first reachable replica (the fleet
+        poller asks each replica directly; this is the failover twin)."""
+        last_err: Exception | None = None
+        for rep in self._candidates():
+            try:
+                with rep.lock:
+                    if rep.client is None:
+                        rep.client = ServiceClient(
+                            rep.addr, timeout_s=self.timeout_s
+                        )
+                    return rep.client.metrics()
+            except (ConnectionError, OSError, CallTimeout) as e:
+                self._mark_down(rep)
+                last_err = e
+        raise ServiceError(
+            "unavailable",
+            f"no replica metrics over {len(self._replicas)} replicas "
+            f"(last: {last_err!r})",
+        )
+
+    def telemetry_flush(self) -> list[dict]:
+        """Pull the residual span ring from EVERY reachable replica.
+
+        The batched piggyback leaves up to ``telemetry_batch - 1``
+        events sitting in each replica's ring; the router calls this
+        when its trace closes so the span tail still lands in the
+        merged file. Every replica is visited (not first-reachable —
+        each holds distinct spans); unreachable ones are skipped, and
+        each reply is probe-annotated for the caller's clock aligner.
+        """
+        replies: list[dict] = []
+        for rep in self._replicas:
+            try:
+                with rep.lock:
+                    if rep.client is None:
+                        rep.client = ServiceClient(
+                            rep.addr, timeout_s=self.timeout_s
+                        )
+                    t_send = round(trace.now_s(), 6)
+                    reply = rep.client._call(
+                        {"type": "telemetry", "t_send": t_send}
+                    )
+                    reply["probe"] = {
+                        "addr": rep.addr,
+                        "t_send": t_send,
+                        "t_done": round(trace.now_s(), 6),
+                    }
+                    replies.append(reply)
+            except (ConnectionError, OSError, CallTimeout):
+                self._mark_down(rep)
+        return replies
 
     def _value(self, reply: dict):
         if reply.get("ok"):

@@ -39,6 +39,22 @@ a ``shard`` field attached. Deadline budgeting forwards the *remaining*
 deadline to every downstream call; scatters always run ascending, so a
 mid-scatter deadline yields the same contiguous-prefix partial contract
 the single server keeps.
+
+Fleet tracing (ISSUE 12): every routed query carries a trace context —
+the client's own, or one minted here (``run_id/<seq>.0``) — and each
+downstream call forwards a child context (``<ctx>/s<shard>.<call>``,
+plus the ReplicaSet's per-attempt suffix), so a shard's ``rpc.query``
+spans are prefix-correlated children of this router's ``rpc.route``.
+When the router is tracing it also asks shards to piggyback their
+bounded span rings on terminal replies; each payload is rebased onto
+the router's timeline via per-replica min-RTT clock alignment (every
+reply echoes receive/send timestamps — the same NTP-style estimator
+the cluster coordinator uses) and ingested under a synthetic per-replica
+pid, so one ``--trace`` file carries the router plus a track per shard
+replica. A reply that should have carried telemetry but didn't
+(``svc_trace_drop`` chaos, or a malformed payload) degrades to
+uncorrelated spans: counted in ``telemetry_gaps``, evented as
+``router_trace_gap``, never an error.
 """
 
 from __future__ import annotations
@@ -48,6 +64,7 @@ import math
 import socket
 import threading
 import types
+import uuid
 from typing import Any
 
 from sieve.chaos import (
@@ -140,15 +157,19 @@ class RouterSettings:
 
 class _RouteCtx:
     """Per-request scatter bookkeeping: which shards were touched, the
-    contiguous prefix answered so far (for typed partials), splices."""
+    contiguous prefix answered so far (for typed partials), splices,
+    and the trace context downstream calls derive children from."""
 
-    __slots__ = ("shards", "answered_hi", "count_so_far", "spliced")
+    __slots__ = ("shards", "answered_hi", "count_so_far", "spliced",
+                 "ctx", "calls")
 
     def __init__(self) -> None:
         self.shards: set[int] = set()
         self.answered_hi = 2
         self.count_so_far = 0
         self.spliced = 0
+        self.ctx = ""
+        self.calls = 0  # downstream calls made — numbers child contexts
 
 
 _ROUTER_STATS = (
@@ -164,7 +185,15 @@ _ROUTER_STATS = (
     "internal_errors",
     "draining_replies",
     "shard_down_windows",
+    "telemetry_merged",
+    "telemetry_events",
+    "telemetry_gaps",
 )
+
+# synthetic pid base for per-shard-replica tracks in the merged trace
+# (the cluster merge uses 1_000_000 + worker id; staying clear of it
+# lets one report read a trace that carries both planes)
+_REPLICA_PID_BASE = 2_000_000
 
 
 class SieveRouter:
@@ -204,6 +233,15 @@ class SieveRouter:
         # svc_shard_down windows: shard index -> monotonic expiry
         self._down_until: dict[int, float] = {}
         self._down_lock = threading.Lock()
+        # fleet tracing (ISSUE 12): trace-ctx run id for requests that
+        # arrive unstamped, per-replica clock aligners keyed by address,
+        # and the synthetic pid each replica's merged track renders under
+        self._run_id = uuid.uuid4().hex[:8]
+        self._tele_lock = threading.Lock()
+        self._aligns: dict[str, trace.ClockAlign] = {}
+        self._replica_pids: dict[str, int] = {}
+        self._replica_shard: dict[str, int] = {}
+        self._replica_named: set[str] = set()
         self._stats = {k: 0 for k in _ROUTER_STATS}
         self._stats_lock = threading.Lock()
         self._seq = 0
@@ -291,6 +329,17 @@ class SieveRouter:
                 pass
         for t in self._threads:
             t.join(timeout=5)
+        if trace.enabled():
+            # pull the residual span ring out of every shard replica
+            # before the connections go away — the batched piggyback
+            # only ships full batches, so the tail of the trace lives
+            # here until this flush merges it
+            for i, rs in enumerate(self.sets):
+                try:
+                    for reply in rs.telemetry_flush():
+                        self._absorb_reply(i, reply)
+                except Exception:  # noqa: BLE001 — stop() must not raise
+                    pass
         for rs in self.sets:
             rs.close()
         self._drained.set()
@@ -366,31 +415,131 @@ class SieveRouter:
         if remaining <= 0:
             raise DeadlineExceeded(rctx.answered_hi, rctx.count_so_far)
         rctx.shards.add(i)
+        rctx.calls += 1
+        # child trace ctx: <route ctx>/s<shard>.<call>; the ReplicaSet
+        # appends its own .<attempt>, so the shard-side span context is
+        # prefix-correlated with this route AND unique per wire attempt
+        child_ctx = f"{rctx.ctx}/s{i}.{rctx.calls}"
         sh = self.map.shards[i]
         t0 = trace.now_s()
         outcome = "ok"
         try:
             try:
                 reply = self.sets[i].query(op, deadline_s=remaining,
+                                           ctx=child_ctx,
+                                           telemetry=trace.enabled(),
                                            **params)
             except (ServiceError, CallTimeout) as e:
                 # ReplicaSet exhaustion ("unavailable") or a poisoned
                 # call: the shard as a whole could not answer
                 outcome = "unavailable"
                 raise ShardUnavailable(i, sh.lo, sh.hi, str(e)) from None
+            self._absorb_reply(i, reply)
             if reply.get("ok"):
                 return reply["value"]
             outcome = str(reply.get("error", "internal"))
             raise _Relay(reply, i)
         finally:
             trace.add_span("route.scatter", t0, trace.now_s() - t0,
-                           shard=i, op=op, outcome=outcome)
+                           shard=i, op=op, outcome=outcome, ctx=child_ctx)
+
+    def _absorb_reply(self, shard: int, reply: dict) -> None:
+        """Fold one downstream reply's trace freight into the router:
+        sample the replica's clock aligner from the echoed timestamps,
+        then rebase + ingest any piggybacked span ring under the
+        replica's synthetic pid. A reply whose telemetry was dropped or
+        mangled degrades to a counted ``router_trace_gap`` — correlation
+        is lost for those spans, the query result is untouched."""
+        probe = reply.get("probe")
+        probe = probe if isinstance(probe, dict) else {}
+        addr = probe.get("addr")
+        align = None
+        if isinstance(addr, str) and addr:
+            with self._tele_lock:
+                align = self._aligns.get(addr)
+                if align is None:
+                    align = self._aligns[addr] = trace.ClockAlign()
+                    self._replica_pids[addr] = (
+                        _REPLICA_PID_BASE + len(self._replica_pids)
+                    )
+                    self._replica_shard[addr] = shard
+            stamps = (probe.get("t_send"), reply.get("t_recv"),
+                      reply.get("t_sent"), probe.get("t_done"))
+            if all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                   for v in stamps):
+                align.sample(*stamps)
+                reg = registry()
+                reg.gauge(f"router.replica.{addr}.clock_offset_s").set(
+                    round(align.offset_s, 6)
+                )
+                reg.gauge(f"router.replica.{addr}.clock_err_s").set(
+                    round(align.err_s, 6)
+                )
+        if "telemetry" not in reply:
+            return  # replica not shipping (e.g. in-process embed): fine
+        tele = reply.pop("telemetry")
+        if not isinstance(tele, dict):
+            self._bump("telemetry_gaps")
+            self.metrics.event(
+                "router_trace_gap", quietable=True, shard=shard,
+                reason="dropped" if tele is None else "malformed",
+                replica=addr or "?",
+            )
+            return
+        events = tele.get("events") or []
+        dropped = int(tele.get("dropped") or 0)
+        with self._tele_lock:
+            key = addr if isinstance(addr, str) and addr else f"shard{shard}"
+            pid = self._replica_pids.get(key)
+            if pid is None:
+                pid = self._replica_pids[key] = (
+                    _REPLICA_PID_BASE + len(self._replica_pids)
+                )
+                self._replica_shard[key] = shard
+            first = key not in self._replica_named
+            self._replica_named.add(key)
+        off_us = (align.offset_s if align is not None and align.samples
+                  else 0.0) * 1e6
+        merged: list[dict] = []
+        if first:
+            merged.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"shard{shard} {key}"},
+            })
+        for e in events:
+            e = dict(e)
+            if "ts" in e:
+                e["ts"] = round(e["ts"] - off_us, 3)
+            e["pid"] = pid
+            merged.append(e)
+        info: dict[str, Any] = {"shard": shard, "replica": key,
+                                "events": len(events), "dropped": dropped}
+        if align is not None and align.samples:
+            info.update(
+                offset_s=round(align.offset_s, 6),
+                rtt_s=round(align.rtt_s, 6),
+                err_s=round(align.err_s, 6),
+                samples=align.samples,
+            )
+        merged.append({
+            "name": "clock.align", "ph": "i", "s": "p",
+            "ts": round(trace.now_s() * 1e6, 3), "pid": pid, "tid": 0,
+            "args": info,
+        })
+        trace.get_tracer().ingest(merged)
+        self._bump("telemetry_merged")
+        self._bump("telemetry_events", len(events))
+        if first:
+            # one track-established event per replica, not one per reply
+            self.metrics.event("router_telemetry", quietable=True, **info)
 
     def _shard_total(self, i: int, deadline: float, rctx: _RouteCtx) -> int:
         """Primes in shard i's full declared range, cached forever."""
         with self._totals_lock:
             if i in self._totals:
+                registry().counter("router.totals_hit").inc()
                 return self._totals[i]
+        registry().counter("router.totals_miss").inc()
         sh = self.map.shards[i]
         total = self._shard_query(i, "count", deadline, rctx,
                                   lo=sh.lo, hi=sh.hi)
@@ -593,7 +742,8 @@ class SieveRouter:
         for i, sh in enumerate(self.map.shards):
             with self._down_lock:
                 held_down = now < self._down_until.get(i, 0.0)
-            ent: dict[str, Any] = {"shard": i, "lo": sh.lo, "hi": sh.hi}
+            ent: dict[str, Any] = {"shard": i, "lo": sh.lo, "hi": sh.hi,
+                                   "addrs": list(sh.addrs)}
             if held_down:
                 ent["status"] = "unavailable"
                 ent["detail"] = "svc_shard_down window live"
@@ -712,6 +862,15 @@ class SieveRouter:
                         {"type": "stats", "id": rid, "ok": True,
                          "stats": self.stats()})
             return
+        if mtype == "metrics":
+            # live telemetry plane (ISSUE 12): the full registry
+            # snapshot, answered inline so fleet_top keeps seeing it
+            # even while the query plane is under pressure
+            self._reply(conn, send_lock,
+                        {"type": "metrics", "id": rid, "ok": True,
+                         "role": "router",
+                         "metrics": registry().snapshot()})
+            return
         if mtype == "shutdown":
             self._reply(conn, send_lock,
                         {"type": "reply", "id": rid, "ok": True,
@@ -766,6 +925,11 @@ class SieveRouter:
         self._bump("requests")
         self._draw_chaos(seq)
         rctx = _RouteCtx()
+        # adopt the client's trace ctx, or mint one so downstream child
+        # contexts are well-formed even for unstamped (old) clients
+        mctx = msg.get("ctx")
+        rctx.ctx = (mctx if isinstance(mctx, str) and mctx
+                    else f"{self._run_id}/{seq}.0")
         outcome = "ok"
         reply: dict = {"type": "reply", "id": rid, "ok": True, "op": op}
         try:
@@ -861,8 +1025,14 @@ class SieveRouter:
         t_end = trace.now_s()
         reply.setdefault("source", "router")
         reply["elapsed_ms"] = round((t_end - t0) * 1000, 3)
+        if isinstance(msg.get("t_send"), (int, float)) \
+                and not isinstance(msg.get("t_send"), bool):
+            # echo receive/send stamps so a tracing CALLER (a client, or
+            # a router-of-routers) can clock-align against this process
+            reply["t_recv"] = round(t0, 6)
+            reply["t_sent"] = round(t_end, 6)
         trace.add_span("rpc.route", t0, t_end - t0, op=op, outcome=outcome,
-                       shards=len(rctx.shards))
+                       shards=len(rctx.shards), ctx=rctx.ctx)
         self.metrics.event(
             "router_request", quietable=True, op=op, outcome=outcome,
             shards=len(rctx.shards), ms=reply["elapsed_ms"],

@@ -177,6 +177,25 @@ def _env_float(name: str, default: float) -> float:
         ) from None
 
 
+# per-op latency SLOs (ISSUE 12): SIEVE_SVC_SLO_MS_PI=5 reads as
+# {"pi": 5.0}; the op name is the env suffix, lowercased
+_SLO_ENV_PREFIX = "SIEVE_SVC_SLO_MS_"
+
+
+def _slo_from_env() -> dict[str, float] | None:
+    out: dict[str, float] = {}
+    for name, raw in os.environ.items():
+        if not name.startswith(_SLO_ENV_PREFIX) or name == _SLO_ENV_PREFIX:
+            continue
+        try:
+            out[name[len(_SLO_ENV_PREFIX):].lower()] = float(raw)
+        except ValueError:
+            raise ValueError(
+                f"env {name}={raw!r}: expected a number (milliseconds)"
+            ) from None
+    return out or None
+
+
 @dataclasses.dataclass
 class ServiceSettings:
     """Service knobs; every default has a ``SIEVE_SVC_*`` env override."""
@@ -222,6 +241,26 @@ class ServiceSettings:
     # queries below range_lo are typed bad_request naming the range —
     # global-semantics composition is the router's job, never a shard's.
     range_lo: int = 2
+    # fleet telemetry (ISSUE 12): ship the bounded span ring piggybacked
+    # on terminal replies that ask for it (``telemetry: true`` on the
+    # query — the router's merge input). OFF by default: an embedded
+    # in-process server shares the host's tracer, and draining it would
+    # steal the host's own spans.
+    telemetry_ship: bool = False
+    # piggyback batching: only attach the ring once this many events are
+    # pending (the ``telemetry`` wire op flushes the remainder — the
+    # router pulls it when its trace closes). Shipping on EVERY reply
+    # would put a serialize on every hot-path request; batching keeps
+    # the traced p95 within the 5% overhead budget (bench line 8). The
+    # default is half the default ring: ships stay rare enough that a
+    # p95 window sees at most one, but the ring never overflows between
+    # ships on a steady request stream.
+    telemetry_batch: int = 2048
+    # per-op latency SLOs: op -> target ms (None = no SLOs). A rolling
+    # window of the last slo_window terminal latencies per op; the op
+    # "burns" while its window p95 exceeds the target.
+    slo_ms: dict[str, float] | None = None
+    slo_window: int = 256
 
     def validate(self) -> "ServiceSettings":
         """Typed startup validation: every rejection names the setting
@@ -275,6 +314,34 @@ class ServiceSettings:
                 f"service settings: range_lo={self.range_lo!r} must be an "
                 "integer >= 2"
             )
+        if (not isinstance(self.slo_window, int)
+                or isinstance(self.slo_window, bool) or self.slo_window <= 0):
+            raise ValueError(
+                f"service settings: slo_window={self.slo_window!r} must be "
+                "a positive integer"
+            )
+        if (not isinstance(self.telemetry_batch, int)
+                or isinstance(self.telemetry_batch, bool)
+                or self.telemetry_batch < 1):
+            raise ValueError(
+                f"service settings: telemetry_batch={self.telemetry_batch!r} "
+                "must be a positive integer"
+            )
+        if self.slo_ms is not None:
+            if not isinstance(self.slo_ms, dict):
+                raise ValueError(
+                    f"service settings: slo_ms={self.slo_ms!r} must be a "
+                    "dict of op -> target ms (or None)"
+                )
+            for op, ms in self.slo_ms.items():
+                if (not isinstance(op, str) or not op
+                        or not isinstance(ms, (int, float))
+                        or isinstance(ms, bool) or ms <= 0
+                        or not math.isfinite(ms)):
+                    raise ValueError(
+                        f"service settings: slo_ms[{op!r}]={ms!r} must map "
+                        "an op name to a positive number of milliseconds"
+                    )
         return self
 
     @classmethod
@@ -317,6 +384,13 @@ class ServiceSettings:
             hot_workers=_env_int("SIEVE_SVC_HOT_WORKERS", cls.hot_workers),
             cold_age_s=_env_float("SIEVE_SVC_COLD_AGE_S", cls.cold_age_s),
             range_lo=_env_int("SIEVE_SVC_RANGE_LO", cls.range_lo),
+            telemetry_ship=os.environ.get("SIEVE_SVC_TELEMETRY", "0")
+            not in ("0", "", "false"),
+            telemetry_batch=_env_int(
+                "SIEVE_SVC_TELEMETRY_BATCH", cls.telemetry_batch
+            ),
+            slo_ms=_slo_from_env(),
+            slo_window=_env_int("SIEVE_SVC_SLO_WINDOW", cls.slo_window),
         )
         return dataclasses.replace(s, **overrides)
 
@@ -746,6 +820,8 @@ _STATS = (
     "draining_replies",
     "bad_requests",
     "internal_errors",
+    "telemetry_replies",
+    "trace_drops",
 )
 
 
@@ -836,6 +912,14 @@ class SieveService:
         # replica_down chaos: while live, every connection is dropped
         # without a reply — a dead replica from the client's side
         self._replica_down_until = 0.0
+        # per-op SLO tracking (ISSUE 12): rolling latency windows and
+        # the set of ops currently burning (p95 over target) — the burn
+        # *transition* is the event, the gauge is the live level
+        self._slo_lock = threading.Lock()
+        self._slo_windows: dict[str, collections.deque] = {}
+        self._slo_burning: set[str] = set()
+        # telemetry shipping: armed in start() when telemetry_ship is on
+        self._telemetry_on = False
 
     # --- lifecycle -------------------------------------------------------
 
@@ -887,6 +971,17 @@ class SieveService:
             self.follower = LedgerFollower(
                 self, self.settings.refresh_s
             ).start()
+        if self.settings.telemetry_ship:
+            # same ship ring as a cluster worker: bounded drop-oldest
+            # capture, drained onto terminal replies that ask for it
+            from sieve.worker import telemetry_ring_size
+
+            ring = telemetry_ring_size()
+            if ring > 0:
+                tr = trace.get_tracer()
+                tr.set_event_limit(ring)
+                tr.enable(clear=False)
+                self._telemetry_on = True
         return self
 
     def drain(self) -> None:
@@ -971,6 +1066,77 @@ class SieveService:
         with self._stats_lock:
             self._stats[name] += n
         registry().counter(f"service.{name}").inc(n)
+
+    # --- SLO tracking (ISSUE 12) ------------------------------------------
+
+    def _observe_slo(self, op: str, elapsed_ms: float) -> None:
+        """Fold one terminal latency into the op's rolling window. The
+        ``service.slo_burn`` gauge carries the worst burn ratio across
+        ops (p95/target; >1 means out of SLO); the ``service_slo_burn``
+        event fires on the transition INTO burn, not per request."""
+        slo = self.settings.slo_ms
+        if not slo:
+            return
+        target = slo.get(op)
+        if target is None:
+            return
+        with self._slo_lock:
+            win = self._slo_windows.get(op)
+            if win is None:
+                win = self._slo_windows[op] = collections.deque(
+                    maxlen=self.settings.slo_window
+                )
+            win.append(elapsed_ms)
+            vals = sorted(win)
+            p95 = vals[max(0, math.ceil(0.95 * len(vals)) - 1)]
+            burn = p95 / target
+            newly = burn > 1.0 and op not in self._slo_burning
+            if burn > 1.0:
+                self._slo_burning.add(op)
+            else:
+                self._slo_burning.discard(op)
+            worst = max(
+                (self._win_burn_locked(o) for o in self._slo_windows),
+                default=0.0,
+            )
+        reg = registry()
+        reg.gauge(f"service.slo_burn.{op}").set(round(burn, 4))
+        reg.gauge("service.slo_burn").set(round(worst, 4))
+        if newly:
+            self.metrics.event(
+                "service_slo_burn", op=op, p95_ms=round(p95, 3),
+                slo_ms=target, window=len(vals),
+            )
+
+    def _win_burn_locked(self, op: str) -> float:
+        win = self._slo_windows.get(op)
+        target = (self.settings.slo_ms or {}).get(op)
+        if not win or not target:
+            return 0.0
+        vals = sorted(win)
+        return vals[max(0, math.ceil(0.95 * len(vals)) - 1)] / target
+
+    def slo_snapshot(self) -> dict:
+        """Per-op SLO state for stats/fleet_top. An op with zero
+        observations reports ``p95_ms: None`` — a cold server has no
+        percentile, and null must never masquerade as a 0 ms p95."""
+        slo = self.settings.slo_ms or {}
+        out: dict[str, dict] = {}
+        with self._slo_lock:
+            for op, target in sorted(slo.items()):
+                win = self._slo_windows.get(op)
+                vals = sorted(win) if win else []
+                p95 = (vals[max(0, math.ceil(0.95 * len(vals)) - 1)]
+                       if vals else None)
+                out[op] = {
+                    "slo_ms": target,
+                    "p95_ms": round(p95, 3) if p95 is not None else None,
+                    "n": len(vals),
+                    "burn": round(p95 / target, 4) if p95 is not None
+                    else None,
+                    "burning": op in self._slo_burning,
+                }
+        return out
 
     # --- lanes (ISSUE 10) -------------------------------------------------
 
@@ -1069,6 +1235,7 @@ class SieveService:
         out["draining"] = self._draining
         out["persist_cold"] = self._writer is not None
         out["range_lo"] = self.base
+        out["slo"] = self.slo_snapshot()
         return out
 
     def _on_degraded(self, entering: bool, reason: str) -> None:
@@ -1164,6 +1331,33 @@ class SieveService:
                         {"type": "reply", "id": rid, "ok": True,
                          "draining": True})
             self.drain()
+            return None
+        if mtype == "metrics":
+            # live telemetry plane (ISSUE 12): the full registry
+            # snapshot, answered inline like health — the fleet poller
+            # must see a wedged server's counters, not time out behind
+            # its queue
+            self._reply(conn, send_lock, {
+                "type": "metrics", "id": rid, "ok": True,
+                "role": "service", "metrics": registry().snapshot(),
+            })
+            return None
+        if mtype == "telemetry":
+            # explicit ring flush: the router pulls this from every
+            # replica when its trace closes, collecting whatever the
+            # batched piggyback has not shipped yet. Echoes the clock
+            # stamps so the flush itself feeds the caller's aligner.
+            reply: dict[str, Any] = {"type": "telemetry", "id": rid,
+                                     "ok": True}
+            if msg.get("t_send") is not None:
+                reply["t_recv"] = round(trace.now_s(), 6)
+            if self._telemetry_on:
+                events, dropped = trace.drain_events()
+                reply["telemetry"] = {"events": events, "dropped": dropped}
+                self._bump("telemetry_replies")
+            if msg.get("t_send") is not None:
+                reply["t_sent"] = round(trace.now_s(), 6)
+            self._reply(conn, send_lock, reply)
             return None
         if mtype == "chaos":
             if not self.settings.wire_chaos:
@@ -1384,9 +1578,13 @@ class SieveService:
         # ``idx`` is the snapshot captured at admission: the whole request
         # runs on it even if the follower swaps self.index mid-flight
         op = str(msg.get("op", ""))
+        # trace ctx (ISSUE 12): echo the caller's context into every span
+        # this request produces, so the router/report can correlate them
+        tctx = msg.get("ctx")
+        tkw = {"ctx": tctx} if isinstance(tctx, str) and tctx else {}
         t_pop = trace.now_s()
         trace.add_span("query.queue_wait", enq_t, t_pop - enq_t, op=op,
-                       lane=lane)
+                       lane=lane, **tkw)
         registry().histogram(f"service.queue_wait_ms.{lane}").observe(
             (t_pop - enq_t) * 1000.0
         )
@@ -1455,7 +1653,8 @@ class SieveService:
         reply.setdefault("source", source)
         reply["elapsed_ms"] = round((t_end - enq_t) * 1000, 3)
         trace.add_span("rpc.query", enq_t, t_end - enq_t, op=op,
-                       outcome=outcome, source=source, lane=lane)
+                       outcome=outcome, source=source, lane=lane, **tkw)
+        self._observe_slo(op, reply["elapsed_ms"])
         # counters/events before the reply: a stats call racing the
         # reply must already see this request accounted for
         if outcome == "ok" and not ctx.cold and not ctx.materialized:
@@ -1472,6 +1671,32 @@ class SieveService:
             "service_request", quietable=True, op=op, outcome=outcome,
             source=source, ms=reply["elapsed_ms"],
         )
+        # telemetry piggyback (ISSUE 12): echo receive/send timestamps
+        # for the caller's clock aligner, and — when asked and armed —
+        # drain the bounded span ring onto this reply (the rpc.query
+        # span above is already in it). Batched: only ship once
+        # telemetry_batch events are pending, so the hot path is not
+        # paying a serialize per reply; the ``telemetry`` wire op
+        # flushes the remainder when the caller's trace closes.
+        # svc_trace_drop ships an explicit null payload: telemetry
+        # lost (the pending ring is discarded, not deferred), query
+        # result untouched.
+        if msg.get("t_send") is not None:
+            reply["t_recv"] = round(enq_t, 6)
+        if msg.get("telemetry"):
+            if any(d["kind"] == "svc_trace_drop" for d in directives):
+                trace.drain_events()
+                reply["telemetry"] = None
+                self._bump("trace_drops")
+                self.metrics.event("service_trace_drop", quietable=True,
+                                   op=op)
+            elif (self._telemetry_on and trace.pending_events()
+                    >= self.settings.telemetry_batch):
+                events, dropped = trace.drain_events()
+                reply["telemetry"] = {"events": events, "dropped": dropped}
+                self._bump("telemetry_replies")
+        if msg.get("t_send") is not None:
+            reply["t_sent"] = round(trace.now_s(), 6)
         try:
             self._reply(conn, send_lock, reply)
         finally:

@@ -102,6 +102,14 @@ class Tracer:
         # events, dropping the oldest non-metadata event on overflow
         self._max_events: int | None = None
         self._dropped = 0
+        # counter tracks are SAMPLED, not transition-logged: at most one
+        # event per track per interval. Metric mirrors fire on every
+        # inc()/set() — several per request on a serving hot path — and
+        # unthrottled they dominate both the ring and the traced-request
+        # latency (the 5% fleet-tracing budget). Perfetto renders a
+        # counter track identically from periodic samples.
+        self._counter_interval_us = 10_000.0
+        self._counter_seen: dict[str, float] = {}
 
     # --- recording -----------------------------------------------------------
 
@@ -150,17 +158,31 @@ class Tracer:
             self._trim()
 
     def counter(self, name: str, value: float) -> None:
-        """Sample a counter/gauge value onto the trace timeline."""
+        """Sample a counter/gauge value onto the trace timeline.
+
+        Throttled per track: samples landing within the counter
+        interval of the previous admitted one are dropped (the first
+        sample of a track always lands)."""
         if not self.enabled:
             return
+        # lock-free throttle fast path: dict reads are GIL-atomic, and
+        # the worst race outcome is one extra sample in an interval —
+        # harmless, while skipping the lock (and the round below) keeps
+        # the per-inc() cost off the serving hot path
+        ts = (time.perf_counter() - _EPOCH) * 1e6
+        last = self._counter_seen.get(name)
+        if last is not None and ts - last < self._counter_interval_us:
+            return
+        ts = round(ts, 3)
         with self._lock:
             if not self.enabled:
                 return
+            self._counter_seen[name] = ts
             self._events.append(
                 {
                     "name": name,
                     "ph": "C",
-                    "ts": round((time.perf_counter() - _EPOCH) * 1e6, 3),
+                    "ts": ts,
                     "pid": os.getpid(),
                     "tid": threading.get_ident(),
                     "args": {"value": value},
@@ -221,6 +243,7 @@ class Tracer:
             if clear:
                 self._events.clear()
                 self._tids_named.clear()
+                self._counter_seen.clear()
                 self._dropped = 0
             self.enabled = True
 
@@ -233,6 +256,7 @@ class Tracer:
             self._events.clear()
             self._totals.clear()
             self._tids_named.clear()
+            self._counter_seen.clear()
             self._dropped = 0
 
     def set_event_limit(self, max_events: int | None) -> None:
@@ -250,6 +274,13 @@ class Tracer:
         """Events evicted by the ring limit since the last fresh enable."""
         with self._lock:
             return self._dropped
+
+    def pending(self) -> int:
+        """Captured events not yet drained — lets a telemetry shipper
+        batch payloads (only piggyback once enough accumulated) instead
+        of paying a serialize on every reply."""
+        with self._lock:
+            return len(self._events)
 
     def drain_events(self) -> tuple[list[dict], int]:
         """Take (and clear) the captured events; returns ``(events,
@@ -316,6 +347,47 @@ class Tracer:
         return agg.get(name, (0.0, 0))[0]
 
 
+class ClockAlign:
+    """NTP-style clock alignment against one remote process.
+
+    Each RPC exchange yields four timestamps: local send, remote
+    receive, remote send, local done (all on their own process trace
+    epochs). The sample with the smallest round-trip gives the best
+    offset estimate; the error bound is half that minimal RTT.
+
+    ``remote_clock ~= local_clock + offset_s``, so rebasing a remote
+    event onto the local timeline subtracts ``offset_s``.
+    """
+
+    __slots__ = ("offset_s", "rtt_s", "samples")
+
+    def __init__(self) -> None:
+        self.offset_s = 0.0
+        self.rtt_s = float("inf")
+        self.samples = 0
+
+    def sample(
+        self,
+        t_send: float,
+        t_remote_recv: float,
+        t_remote_send: float,
+        t_done: float,
+    ) -> None:
+        rtt = max(0.0, (t_done - t_send) - (t_remote_send - t_remote_recv))
+        self.samples += 1
+        # ties refresh to the newest sample so the estimate tracks drift
+        if rtt <= self.rtt_s:
+            self.rtt_s = rtt
+            self.offset_s = (
+                (t_remote_recv - t_send) + (t_remote_send - t_done)
+            ) / 2
+
+    @property
+    def err_s(self) -> float:
+        """Worst-case offset error: half the best round-trip seen."""
+        return self.rtt_s / 2 if self.samples else float("inf")
+
+
 # Process-wide tracer and module-level conveniences (the instrumented
 # call sites all go through these).
 _TRACER = Tracer()
@@ -363,6 +435,10 @@ def set_event_limit(max_events: int | None) -> None:
 
 def drain_events() -> tuple[list[dict], int]:
     return _TRACER.drain_events()
+
+
+def pending_events() -> int:
+    return _TRACER.pending()
 
 
 def ingest(events: list[dict]) -> None:

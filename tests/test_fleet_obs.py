@@ -1,0 +1,497 @@
+"""Fleet-wide request tracing + live telemetry plane (ISSUE 12).
+
+Covers: client/router/replica trace-ctx propagation (stamping, child
+contexts, fresh per-attempt suffixes on retry); the bounded span ring
+(drop accounting, counter-track throttling); the batched telemetry
+piggyback, the ``telemetry`` flush op, and ``svc_trace_drop`` chaos
+(grammar + wire behavior); the 2-shard SUBPROCESS merged-trace run with
+the >=95% route->query correlation acceptance gate; the ``metrics``
+wire op on server and router; ``tools/fleet_top.py`` snapshot schema
+and rendering; per-op SLO burn (event schema, gauges, empty-window
+nulls); and trace_report's malformed-input exit + routed-report guards.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sieve import metrics, trace
+from sieve.chaos import parse_chaos
+from sieve.checkpoint import Ledger
+from sieve.config import SieveConfig
+from sieve.coordinator import run_local
+from sieve.metrics import MemorySink, registry, validate_record
+from sieve.seed import seed_primes
+from sieve.service import (
+    ReplicaSet,
+    RouterSettings,
+    ServiceClient,
+    ServiceSettings,
+    Shard,
+    ShardMap,
+    SieveRouter,
+    SieveService,
+)
+from sieve.service.client import CallTimeout
+
+REPO = Path(__file__).resolve().parent.parent
+
+N = 50_000
+P = seed_primes(200_000)
+
+
+def o_pi(x):
+    return int(np.searchsorted(P, x, side="right"))
+
+
+def o_count(lo, hi):
+    return int(np.searchsorted(P, hi, side="left")
+               - np.searchsorted(P, lo, side="left"))
+
+
+@pytest.fixture
+def memsink():
+    sink = MemorySink()
+    metrics.add_sink(sink)
+    yield sink
+    metrics.remove_sink(sink)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Each test starts and ends with a disabled, unbounded tracer."""
+    yield
+    trace.drain_events()
+    trace.disable()
+    trace.set_event_limit(None)
+
+
+def _cfg(checkpoint_dir, **kw):
+    base = dict(
+        n=N, backend="cpu-numpy", packing="wheel30", n_segments=4,
+        quiet=True, checkpoint_dir=checkpoint_dir,
+    )
+    base.update(kw)
+    return SieveConfig(**base)
+
+
+def _settings(**kw):
+    base = dict(workers=2, queue_limit=16, default_deadline_s=10.0,
+                refresh_s=0.0)
+    base.update(kw)
+    return ServiceSettings(**base)
+
+
+@pytest.fixture(scope="module")
+def src_dir(tmp_path_factory):
+    path = tmp_path_factory.mktemp("fleet_src")
+    run_local(_cfg(str(path)))
+    return path
+
+
+def _split_shards(src_dir, tmp_path):
+    segs = sorted(
+        Ledger.open_readonly(_cfg(str(src_dir))).completed().values(),
+        key=lambda r: r.lo,
+    )
+    E = segs[2].lo
+    dirs = (tmp_path / "shard0", tmp_path / "shard1")
+    for d, part in zip(dirs, (segs[:2], segs[2:])):
+        led = Ledger.open(_cfg(str(d)))
+        for r in part:
+            led.record(r)
+    return str(dirs[0]), str(dirs[1]), E
+
+
+def _replace(settings, **kw):
+    import dataclasses
+    return dataclasses.replace(settings, **kw)
+
+
+class _Fabric:
+    """Two-shard in-process fabric (one replica each) + router."""
+
+    def __init__(self, src_dir, tmp_path, shard_settings=None,
+                 shard1_chaos=None):
+        d0, d1, self.E = _split_shards(src_dir, tmp_path)
+        sset = shard_settings or _settings()
+        self.svcs = [
+            SieveService(_cfg(d0), sset).start(),
+            SieveService(_cfg(d1, chaos=shard1_chaos),
+                         _replace(sset, range_lo=self.E)).start(),
+        ]
+        self.map = ShardMap([
+            Shard(2, self.E, (self.svcs[0].addr,)),
+            Shard(self.E, N + 1, (self.svcs[1].addr,)),
+        ])
+        self.router = SieveRouter(
+            self.map, RouterSettings(quiet=True)).start()
+        self.cli = ServiceClient(self.router.addr, timeout_s=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.cli.close()
+        self.router.stop()
+        for s in self.svcs:
+            s.stop()
+
+
+# --- ctx propagation ---------------------------------------------------------
+
+
+def test_client_stamps_ctx_and_router_forwards_children(src_dir, tmp_path):
+    trace.enable()
+    with _Fabric(src_dir, tmp_path) as f:
+        assert f.cli.is_prime(17)
+        lo = f.E + 10
+        assert f.cli.count(lo, lo + 50) == o_count(lo, lo + 50)
+    trace.disable()
+    events = trace.get_tracer().events()
+    routes = [e for e in events if e.get("name") == "rpc.route"]
+    queries = [e for e in events if e.get("name") == "rpc.query"]
+    assert len(routes) == 2
+    for r in routes:
+        rctx = (r.get("args") or {}).get("ctx", "")
+        # the ServiceClient stamped run_id/<seq>.0 before the router saw it
+        head, tail = rctx.rsplit("/", 1)
+        assert head and tail.endswith(".0")
+        kids = [q for q in queries
+                if (q.get("args") or {}).get("ctx", "")
+                .rsplit("/", 1)[0] == rctx]
+        assert len(kids) == 1, f"route {rctx} should have one child"
+        kctx = kids[0]["args"]["ctx"]
+        # child = <route ctx>/s<shard>.<call>.<attempt>
+        assert kctx.startswith(rctx + "/s")
+        assert kctx.rsplit(".", 1)[1] == "0"  # first wire attempt
+
+
+def test_replica_retry_gets_fresh_attempt_ctx(src_dir, tmp_path, monkeypatch):
+    d0, _d1, _E = _split_shards(src_dir, tmp_path)
+    seen = []
+    orig_call = ServiceClient._call
+    state = {"failed": False}
+
+    def flaky(self, msg):
+        if msg.get("type") == "query":
+            seen.append(msg["ctx"])
+            if not state["failed"]:
+                state["failed"] = True
+                raise CallTimeout("injected: first attempt dies")
+        return orig_call(self, msg)
+
+    monkeypatch.setattr(ServiceClient, "_call", flaky)
+    with SieveService(_cfg(d0), _settings()) as svc:
+        rs = ReplicaSet([svc.addr], timeout_s=10, rounds=3,
+                        backoff_base_s=0.0, backoff_cap_s=0.0,
+                        circuit_cooldown_s=0.0)
+        reply = rs.query("pi", ctx="root/7", x=1000)
+        rs.close()
+    assert reply["ok"] and reply["value"] == o_pi(1000)
+    # same base, fresh .attempt per wire try — retried spans never alias
+    assert seen == ["root/7.0", "root/7.1"]
+
+
+# --- bounded ring + counter throttle ----------------------------------------
+
+
+def test_ring_drop_bounds_and_accounting():
+    tr = trace.Tracer()
+    tr.enable()
+    tr.set_event_limit(4)
+    for i in range(10):
+        tr.add_span("ring.span", float(i), 0.001, i=i)
+    kept = [e for e in tr.events() if e.get("ph") != "M"]
+    assert len(kept) <= 4
+    assert tr.dropped == 10 - len(kept)
+    # the survivors are the NEWEST spans (oldest evicted first)
+    survivors = [e["args"]["i"] for e in kept]
+    assert survivors == list(range(10 - len(survivors), 10))
+
+
+def test_counter_tracks_are_throttled_not_transition_logged():
+    tr = trace.Tracer()
+    tr.enable()
+    tr.counter("q.depth", 1)
+    tr.counter("q.depth", 2)  # same interval: dropped
+    tr.counter("q.other", 5)  # first sample of another track: lands
+    assert [e["name"] for e in tr.events() if e["ph"] == "C"] \
+        == ["q.depth", "q.other"]
+    tr._counter_interval_us = 0.0  # interval elapsed
+    tr.counter("q.depth", 3)
+    vals = [e["args"]["value"] for e in tr.events()
+            if e["ph"] == "C" and e["name"] == "q.depth"]
+    assert vals == [1, 3]
+
+
+# --- telemetry piggyback, flush op, chaos drop ------------------------------
+
+
+def test_piggyback_batches_and_flush_op_drains(src_dir, tmp_path):
+    d0, _d1, _E = _split_shards(src_dir, tmp_path)
+    trace.enable()
+    with SieveService(
+        _cfg(d0),
+        _settings(telemetry_ship=True, telemetry_batch=10_000),
+    ) as svc:
+        rs = ReplicaSet([svc.addr], timeout_s=10)
+        reply = rs.query("pi", telemetry=True, x=1000)
+        # below the batch threshold: the reply must NOT pay a serialize
+        assert reply["ok"] and "telemetry" not in reply
+        # but the explicit flush op always drains the ring
+        flushed = rs.telemetry_flush()
+        assert len(flushed) == 1
+        tele = flushed[0]["telemetry"]
+        assert tele["dropped"] >= 0
+        assert any(e.get("name") == "rpc.query" for e in tele["events"])
+        assert flushed[0]["probe"]["addr"] == svc.addr
+        assert flushed[0]["t_recv"] <= flushed[0]["t_sent"]
+        # batch=1: the very next traced reply carries the ring inline
+        svc.settings.telemetry_batch = 1
+        reply2 = rs.query("pi", telemetry=True, x=2000)
+        assert reply2["telemetry"]["events"]
+        rs.close()
+
+
+def test_svc_trace_drop_discards_ring_and_nulls_payload(
+        src_dir, tmp_path, memsink):
+    d0, _d1, _E = _split_shards(src_dir, tmp_path)
+    trace.enable()
+    with SieveService(
+        _cfg(d0, chaos="svc_trace_drop:any@s1"),
+        _settings(telemetry_ship=True, telemetry_batch=1),
+    ) as svc:
+        rs = ReplicaSet([svc.addr], timeout_s=10)
+        r1 = rs.query("pi", telemetry=True, x=1000)
+        # request 1: answered exactly, telemetry explicitly lost
+        assert r1["ok"] and r1["value"] == o_pi(1000)
+        assert r1["telemetry"] is None
+        r2 = rs.query("pi", telemetry=True, x=2000)
+        # the dropped ring was discarded, not deferred: request 2 ships
+        # only spans captured AFTER the drop
+        ctxs = [(e.get("args") or {}).get("ctx") for e in r2["telemetry"]
+                ["events"] if e.get("name") == "rpc.query"]
+        assert len(ctxs) == 1  # only request 2's span, not request 1's
+        assert svc.stats()["trace_drops"] == 1
+        rs.close()
+    drops = [r for r in memsink.records
+             if r.get("event") == "service_trace_drop"]
+    assert len(drops) == 1 and drops[0]["op"] == "pi"
+    validate_record(drops[0])
+
+
+def test_chaos_grammar_svc_trace_drop():
+    d = parse_chaos("svc_trace_drop:any@s3")
+    assert len(d) == 1 and d[0].kind == "svc_trace_drop"
+    assert d[0].seg_id == 3 and d[0].param is None
+    with pytest.raises(ValueError, match="takes no param"):
+        parse_chaos("svc_trace_drop:any@s3:2")
+
+
+# --- the acceptance gate: 2-shard subprocess merged trace --------------------
+
+
+def test_two_shard_subprocess_merged_trace_correlation(src_dir, tmp_path):
+    """Routed workload over two SUBPROCESS shards -> ONE merged trace
+    where >=95% of rpc.route spans have exactly one rpc.query child on a
+    rebased per-replica track."""
+    d0, d1, E = _split_shards(src_dir, tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO),
+               SIEVE_SVC_TELEMETRY="1")
+    procs, addrs = [], []
+    try:
+        for d, extra in ((d0, []), (d1, ["--range-lo", str(E)])):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "sieve", "serve",
+                 "--addr", "127.0.0.1:0", "--n", str(N), "--segments", "4",
+                 "--packing", "wheel30", "--checkpoint-dir", d,
+                 "--refresh-s", "0", "--quiet", *extra],
+                env=env, cwd=str(REPO), stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+            procs.append(p)
+            head = json.loads(p.stdout.readline())
+            assert head["event"] == "serving"
+            addrs.append(head["addr"])
+
+        trace.enable()
+        smap = ShardMap([Shard(2, E, (addrs[0],)),
+                         Shard(E, N + 1, (addrs[1],))])
+        router = SieveRouter(smap, RouterSettings(quiet=True)).start()
+        with ServiceClient(router.addr, timeout_s=30) as cli:
+            for i in range(20):  # point routes on both sides of E
+                x = (97 * (i + 1)) % N
+                assert cli.is_prime(x) == bool(o_count(x, x + 1))
+            for i in range(20):  # in-shard windowed counts
+                lo = (211 * (i + 1)) % (N - 300)
+                if lo < E <= lo + 200:
+                    lo = E  # keep the window inside one shard
+                assert cli.count(lo, lo + 200) == o_count(lo, lo + 200)
+            assert cli.pi(N - 1) == o_pi(N - 1)  # one 2-shard scatter
+        router.stop()  # pulls the final telemetry flush from every shard
+        stats = router.stats()
+        trace.disable()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+
+    events = trace.get_tracer().events()
+    # ONE trace, one rebased track per shard replica
+    tracks = {e["args"]["name"]: e["pid"] for e in events
+              if e.get("ph") == "M" and e.get("name") == "process_name"
+              and str((e.get("args") or {}).get("name", "")
+                      ).startswith("shard")}
+    assert len(tracks) == 2
+    replica_pids = set(tracks.values())
+    routes = [e for e in events if e.get("name") == "rpc.route"]
+    assert len(routes) == 41
+    kids_by_base = {}
+    for q in (e for e in events if e.get("name") == "rpc.query"
+              and e.get("pid") in replica_pids):
+        base = (q.get("args") or {}).get("ctx", "").rsplit("/", 1)[0]
+        kids_by_base.setdefault(base, []).append(q)
+    exactly_one = sum(
+        1 for r in routes
+        if len(kids_by_base.get((r.get("args") or {}).get("ctx"), [])) == 1
+    )
+    assert exactly_one / len(routes) >= 0.95
+    # the merge plane did real work and saw no gaps
+    assert stats["telemetry_merged"] >= 2
+    assert stats["telemetry_gaps"] == 0
+    assert any(e.get("name") == "clock.align" for e in events)
+
+
+# --- metrics wire op + fleet_top --------------------------------------------
+
+
+def test_metrics_op_on_server_and_router(src_dir, tmp_path):
+    with _Fabric(src_dir, tmp_path) as f:
+        assert f.cli.is_prime(101)
+        raw = f.cli._call({"type": "metrics"})
+        assert raw["ok"] and raw["role"] == "router"
+        snap = raw["metrics"]
+        assert snap["router.requests"]["type"] == "counter"
+        with ServiceClient(f.svcs[0].addr, timeout_s=10) as scli:
+            sraw = scli._call({"type": "metrics"})
+            assert sraw["role"] == "service"
+            assert scli.metrics()["service.requests"]["value"] >= 1
+        # histograms with zero observations snapshot None, never 0
+        empty = [v for v in snap.values()
+                 if v.get("type") == "histogram" and v["count"] == 0]
+        assert all(v["mean"] is None for v in empty)
+
+
+def test_fleet_top_snapshot_schema_and_render(src_dir, tmp_path):
+    from tools.fleet_top import fleet_snapshot, render
+    with _Fabric(src_dir, tmp_path) as f:
+        assert f.cli.is_prime(101)
+        assert f.cli.pi(N - 5) == o_pi(N - 5)
+        snap = fleet_snapshot(f.router.addr, timeout_s=10)
+        assert sorted(snap) == ["router", "shards", "ts"]
+        assert snap["router"]["error"] is None
+        assert len(snap["shards"]) == 2
+        for sh in snap["shards"]:
+            assert len(sh["replicas"]) == 1
+            rep = sh["replicas"][0]
+            assert rep["health"]["status"] in ("ok", "degraded")
+            assert "slo" in rep["stats"]
+            assert rep["metrics"]["service.requests"]["value"] >= 0
+        frame1 = render(snap)
+        assert "router" in frame1 and "contiguous" in frame1
+        assert frame1.count("s0 ") + frame1.count("s1 ") >= 2
+        time.sleep(0.05)
+        assert f.cli.is_prime(103)
+        snap2 = fleet_snapshot(f.router.addr, timeout_s=10)
+        frame2 = render(snap2, prev=snap)
+        assert "/s" in frame2  # second frame shows rates, not totals
+        # no SLOs configured: burn renders "-", never a fake 0
+        assert frame2.rstrip().endswith("-")
+
+
+def test_fleet_top_unreachable_router_renders_error():
+    from tools.fleet_top import fleet_snapshot, render
+    snap = fleet_snapshot("127.0.0.1:1", timeout_s=0.2)
+    assert snap["router"]["health"] is None
+    assert "UNREACHABLE" in render(snap)
+
+
+# --- SLO burn ----------------------------------------------------------------
+
+
+def test_slo_burn_event_gauges_and_stats(src_dir, tmp_path, memsink):
+    d0, _d1, _E = _split_shards(src_dir, tmp_path)
+    with SieveService(
+        _cfg(d0),
+        _settings(slo_ms={"pi": 0.0001, "count": 50.0}, slo_window=8),
+    ) as svc, ServiceClient(svc.addr, timeout_s=10) as cli:
+        assert cli.pi(1000) == o_pi(1000)
+        slo = svc.stats()["slo"]
+    # pi burned (no real query finishes in 0.1us); the event is typed
+    assert slo["pi"]["burn"] > 1.0 and slo["pi"]["burning"]
+    assert slo["pi"]["n"] == 1
+    # count never observed: percentile and burn are null, not 0
+    assert slo["count"]["p95_ms"] is None and slo["count"]["burn"] is None
+    burns = [r for r in memsink.records
+             if r.get("event") == "service_slo_burn"]
+    assert len(burns) == 1 and burns[0]["op"] == "pi"
+    validate_record(burns[0])
+    assert burns[0]["slo_ms"] == 0.0001
+    assert registry().gauge("service.slo_burn.pi").value > 1.0
+    assert registry().gauge("service.slo_burn").value > 1.0
+
+
+def test_slo_env_parsing(monkeypatch):
+    monkeypatch.setenv("SIEVE_SVC_SLO_MS_PI", "5")
+    monkeypatch.setenv("SIEVE_SVC_SLO_MS_COUNT", "12.5")
+    s = ServiceSettings.from_env()
+    assert s.slo_ms == {"pi": 5.0, "count": 12.5}
+    monkeypatch.setenv("SIEVE_SVC_SLO_MS_PI", "fast")
+    with pytest.raises(ValueError, match="expected a number"):
+        ServiceSettings.from_env()
+
+
+def test_telemetry_batch_env_and_validation(monkeypatch):
+    monkeypatch.setenv("SIEVE_SVC_TELEMETRY_BATCH", "64")
+    assert ServiceSettings.from_env().telemetry_batch == 64
+    with pytest.raises(ValueError, match="telemetry_batch"):
+        ServiceSettings(telemetry_batch=0).validate()
+
+
+# --- trace_report ------------------------------------------------------------
+
+
+def test_trace_report_malformed_json_named_exit(tmp_path, capsys):
+    from tools.trace_report import main
+    bad = tmp_path / "trace.json"
+    bad.write_text('{"traceEvents": [{"name": "x"')  # truncated
+    assert main([str(bad)]) == 1
+    err = capsys.readouterr().err
+    assert "trace_report: error:" in err
+    assert "malformed or truncated" in err
+
+
+def test_routed_report_guards_and_correlation():
+    from tools.trace_report import routed_report
+    assert "no rpc.route spans" in routed_report([])
+    base = "r1/1.0"
+    events = [
+        {"name": "process_name", "ph": "M", "pid": 2_000_001,
+         "args": {"name": "shard0 127.0.0.1:9"}},
+        {"name": "rpc.route", "ph": "X", "ts": 1.0, "dur": 500.0,
+         "pid": 1, "args": {"op": "pi", "outcome": "ok", "ctx": base}},
+        {"name": "rpc.query", "ph": "X", "ts": 2.0, "dur": 100.0,
+         "pid": 2_000_001,
+         "args": {"op": "pi", "outcome": "ok", "ctx": f"{base}/s0.1.0"}},
+    ]
+    out = routed_report(events)
+    assert "1/1" in out or "100" in out  # correlated route reported
+    assert "shard0" in out

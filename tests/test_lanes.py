@@ -170,7 +170,8 @@ def test_health_and_stats_key_schema_snapshot(service):
         "lru_entries", "lru_hits", "materialized", "persist_cold",
         "queue_depth", "queue_depth_cold", "queue_depth_hot", "range_lo",
         "refresh_attempts", "refresh_failed", "refreshes", "requests",
-        "segments", "shed", "snapshot_age_s", "total_primes",
+        "segments", "shed", "slo", "snapshot_age_s", "telemetry_replies",
+        "total_primes", "trace_drops",
     ]
 
 

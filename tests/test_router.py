@@ -506,8 +506,8 @@ def test_router_health_and_stats_key_schema_snapshot(src_dirs, tmp_path):
         assert len(h["shards"]) == 2
         for i, sh in enumerate(h["shards"]):
             assert sorted(sh) == [
-                "brownout", "covered_hi", "draining", "hi", "lo",
-                "queue_depth", "shard", "status",
+                "addrs", "brownout", "covered_hi", "draining", "hi",
+                "lo", "queue_depth", "shard", "status",
             ]
             assert sh["shard"] == i and sh["status"] == "ok"
         st = f.cli.stats()
@@ -516,8 +516,9 @@ def test_router_health_and_stats_key_schema_snapshot(src_dirs, tmp_path):
             "draining_replies", "failovers", "internal_errors", "probes",
             "range_hi", "range_lo", "requests", "routed_point",
             "scattered", "shard_count", "shard_down_windows",
-            "shard_errors", "shed_relayed", "spliced", "totals_cached",
-            "unavailable_replies",
+            "shard_errors", "shed_relayed", "spliced",
+            "telemetry_events", "telemetry_gaps", "telemetry_merged",
+            "totals_cached", "unavailable_replies",
         ]
         # a downed shard degrades fabric health and breaks contiguity
         f.svcs[1].stop()

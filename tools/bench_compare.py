@@ -10,8 +10,11 @@ newest rounds by metric name and prints the delta for each; it exits
 nonzero when any throughput metric (``unit == "values/s/chip"``)
 regressed by more than ``--threshold`` (default 10%), when any latency
 metric (``unit == "ms_p95"``) *increased* by more than the same
-threshold (lower is better — the service p95 gate, ISSUE 9), or when
-the newest round itself failed (``rc != 0`` / ``ok == false``).
+threshold (lower is better — the service p95 gate, ISSUE 9), when any
+``unit == "overhead_ratio"`` metric exceeds the ABSOLUTE 1.05 ceiling
+(the fleet-tracing <=5% budget, ISSUE 12 — applied even to a metric's
+first round, since the ceiling needs no baseline), or when the newest
+round itself failed (``rc != 0`` / ``ok == false``).
 
 Round order comes from the ``_r<NN>`` filename suffix, NOT mtime — a
 re-checkout or ``touch`` must not reorder history.
@@ -29,6 +32,9 @@ import re
 import sys
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
+# the fleet-tracing budget (ISSUE 12): traced p95 / untraced p95 must
+# stay within 5% — an absolute gate, not a round-over-round one
+_OVERHEAD_CEILING = 1.05
 
 
 def find_rounds(bench_dir: str, prefix: str) -> list[tuple[int, str]]:
@@ -75,6 +81,19 @@ def compare(
     new_names: list[str] = []
     for name in sorted(old.keys() | new.keys()):
         o, n = old.get(name), new.get(name)
+        # absolute ceilings apply regardless of history — including a
+        # metric's very first round, where there is no old value to diff
+        if n is not None and n.get("unit") == "overhead_ratio" \
+                and float(n["value"]) > _OVERHEAD_CEILING:
+            regressions.append(
+                f"{name}: {float(n['value']):.4g} exceeds the absolute "
+                f"{_OVERHEAD_CEILING} overhead ceiling"
+            )
+            lines.append(
+                f"  {name}: {float(n['value']):.4g} overhead_ratio  "
+                f"REGRESSION (> {_OVERHEAD_CEILING} absolute ceiling)"
+            )
+            continue
         if o is None:
             # a metric present only in the newest round is reported
             # explicitly (it becomes next round's baseline), never

@@ -1,0 +1,219 @@
+"""Live fleet telemetry: poll a sieve router + every shard replica into
+one refreshing terminal table (ISSUE 12).
+
+Each poll asks the router for health (which names every shard replica
+address), stats, and the new ``metrics`` wire op, then asks each replica
+for the same three. The rendered table shows, per replica: lane queue
+depths, shed/demotion rates, LRU and cold-cache hit rates, cold dispatch
+rate, covered_hi, and the worst per-op SLO burn — plus a router header
+with request rate, totals-cache hit rate, telemetry merge/gap counters,
+and fabric coverage contiguity. Rates are deltas between consecutive
+polls; the first frame shows totals only.
+
+Percentiles with zero observations render as ``-`` — never a fake 0.
+
+Usage:
+    python tools/fleet_top.py 127.0.0.1:7733 [--interval 2.0] [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Any
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sieve.service.client import ServiceClient  # noqa: E402
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def _poll(addr: str, timeout_s: float) -> dict[str, Any]:
+    """health + stats + metrics of one endpoint, or a named error."""
+    try:
+        with ServiceClient(addr, timeout_s=timeout_s) as cli:
+            return {
+                "addr": addr,
+                "health": cli.health(),
+                "stats": cli.stats(),
+                "metrics": cli.metrics(),
+                "error": None,
+            }
+    except Exception as e:  # noqa: BLE001 — a dead replica is a table row
+        return {"addr": addr, "health": None, "stats": None,
+                "metrics": None, "error": f"{type(e).__name__}: {e}"}
+
+
+def fleet_snapshot(router_addr: str, timeout_s: float = 5.0) -> dict:
+    """One poll of the whole fleet (pure data; rendering is separate).
+
+    Returns ``{"ts": epoch_s, "router": {...}, "shards": [...]}`` where
+    each shard entry carries the router's view (range, status) plus a
+    polled row per replica address."""
+    router = _poll(router_addr, timeout_s)
+    shards: list[dict[str, Any]] = []
+    h = router["health"]
+    if h is not None:
+        for ent in h.get("shards", []):
+            shards.append({
+                "shard": ent.get("shard"),
+                "lo": ent.get("lo"),
+                "hi": ent.get("hi"),
+                "status": ent.get("status"),
+                "replicas": [
+                    _poll(a, timeout_s) for a in ent.get("addrs", [])
+                ],
+            })
+    return {"ts": time.time(), "router": router, "shards": shards}
+
+
+def _rate(cur: dict | None, prev: dict | None, key: str,
+          dt: float | None) -> str:
+    """Per-second delta between polls, or the running total on frame 1."""
+    if cur is None:
+        return "-"
+    v = cur.get(key)
+    if v is None:
+        return "-"
+    if prev is None or dt is None or dt <= 0 or prev.get(key) is None:
+        return str(v)
+    return f"{max(0, v - prev[key]) / dt:.1f}/s"
+
+
+def _ratio(num: int | None, den: int | None) -> str:
+    if not den:
+        return "-"
+    return f"{100.0 * (num or 0) / den:.0f}%"
+
+
+def _worst_burn(stats: dict | None) -> str:
+    """Worst per-op SLO burn from a replica's ``slo`` stats block; ``-``
+    when no SLOs are set or no op has observations yet."""
+    if not stats:
+        return "-"
+    slo = stats.get("slo") or {}
+    burns = [v.get("burn") for v in slo.values()
+             if isinstance(v, dict) and v.get("burn") is not None]
+    if not burns:
+        return "-"
+    worst = max(burns)
+    return f"{worst:.2f}x" + ("!" if worst > 1.0 else "")
+
+
+def _prev_stats(prev: dict | None, shard: int | None,
+                addr: str) -> dict | None:
+    if prev is None:
+        return None
+    for sh in prev.get("shards", []):
+        if sh.get("shard") != shard:
+            continue
+        for rep in sh.get("replicas", []):
+            if rep.get("addr") == addr:
+                return rep.get("stats")
+    return None
+
+
+def render(snap: dict, prev: dict | None = None) -> str:
+    """One text frame from a :func:`fleet_snapshot` (pure function)."""
+    lines: list[str] = []
+    dt = (snap["ts"] - prev["ts"]) if prev else None
+    r = snap["router"]
+    rh, rs, rm = r["health"], r["stats"], r["metrics"]
+    if rh is None:
+        return f"router {r['addr']}: UNREACHABLE ({r['error']})"
+    covered = rh.get("covered_hi") or 0
+    hi = rh.get("range_hi") or 0
+    contiguous = covered >= hi
+    tot_hit = (rm.get("router.totals_hit") or {}).get("value", 0)
+    tot_miss = (rm.get("router.totals_miss") or {}).get("value", 0)
+    lines.append(
+        f"router {r['addr']}  status={rh.get('status')}  "
+        f"shards={rh.get('shard_count')}  "
+        f"range=[{rh.get('range_lo')}, {hi})  "
+        f"covered_hi={covered} "
+        f"({'contiguous' if contiguous else 'GAP'})"
+    )
+    prs = prev["router"]["stats"] if prev and prev["router"]["stats"] else None
+    lines.append(
+        f"  requests={_rate(rs, prs, 'requests', dt)}  "
+        f"scattered={_rate(rs, prs, 'scattered', dt)}  "
+        f"totals-cache hit={_ratio(tot_hit, tot_hit + tot_miss)}  "
+        f"telemetry merged={rs.get('telemetry_merged', 0)} "
+        f"gaps={rs.get('telemetry_gaps', 0)}  "
+        f"failovers={rs.get('failovers', 0)}"
+    )
+    lines.append("")
+    lines.append(
+        f"  {'replica':<22} {'st':<4} {'hot':>4} {'cold':>4} "
+        f"{'shed':>8} {'demote':>8} {'lru':>5} {'ccache':>6} "
+        f"{'colddisp':>9} {'covered_hi':>11} {'slo burn':>9}"
+    )
+    for sh in snap["shards"]:
+        for rep in sh["replicas"]:
+            name = f"s{sh['shard']} {rep['addr']}"
+            if rep["health"] is None:
+                lines.append(f"  {name:<22} DOWN ({rep['error']})")
+                continue
+            h, st = rep["health"], rep["stats"]
+            ps = _prev_stats(prev, sh["shard"], rep["addr"])
+            shed = (st.get("shed", 0) + st.get("lane_shed_hot", 0)
+                    + st.get("lane_shed_cold", 0))
+            shed_r = _rate({"shed_all": shed},
+                           {"shed_all": ((ps.get("shed", 0)
+                                          + ps.get("lane_shed_hot", 0)
+                                          + ps.get("lane_shed_cold", 0))
+                                         if ps else None)},
+                           "shed_all", dt)
+            lru = _ratio(st.get("lru_hits"),
+                         (st.get("lru_hits") or 0)
+                         + (st.get("cold_computes") or 0))
+            ccache = _ratio(st.get("cold_cache_hits"),
+                            (st.get("cold_cache_hits") or 0)
+                            + (st.get("cold_dispatches") or 0))
+            lines.append(
+                f"  {name:<22} {str(h.get('status', '?'))[:4]:<4} "
+                f"{h.get('queue_depth_hot', 0):>4} "
+                f"{h.get('queue_depth_cold', 0):>4} "
+                f"{shed_r:>8} {_rate(st, ps, 'demoted', dt):>8} "
+                f"{lru:>5} {ccache:>6} "
+                f"{_rate(st, ps, 'cold_dispatches', dt):>9} "
+                f"{h.get('covered_hi', 0):>11} {_worst_burn(st):>9}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="live fleet table over a sieve router and its shard "
+                    "replicas (health + stats + the metrics wire op)"
+    )
+    p.add_argument("router_addr", help="router host:port")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between polls (default 2)")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-endpoint RPC timeout")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (no screen clear)")
+    args = p.parse_args(argv)
+    prev: dict | None = None
+    try:
+        while True:
+            snap = fleet_snapshot(args.router_addr, timeout_s=args.timeout)
+            frame = render(snap, prev)
+            if args.once:
+                print(frame)
+                return 0 if snap["router"]["health"] is not None else 1
+            print(f"{_CLEAR}{time.strftime('%H:%M:%S')}  "
+                  f"(every {args.interval:g}s, ctrl-C to quit)")
+            print(frame, flush=True)
+            prev = snap
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,7 +12,18 @@ ranking, rpc.assign <-> worker.segment correlation/nesting after clock
 rebasing, the membership timeline (worker joins/leaves and adaptive
 deadline adjustments), and the per-worker clock-alignment error report.
 
-Usage: python tools/trace_report.py TRACE_FILE [--top N] [--cluster]
+``--routed`` renders the fleet view of a merged ROUTER trace (ISSUE 12,
+see sieve/service/router.py): router ``rpc.route`` spans correlated by
+trace-context prefix with the shard-replica ``rpc.query`` children
+merged under per-replica tracks, plus each replica's clock-alignment
+error bound.
+
+A file that is not valid trace JSON (truncated write, wrong file, a
+bare object without ``traceEvents``) exits 1 with a named
+``trace_report: error:`` line instead of a traceback.
+
+Usage: python tools/trace_report.py TRACE_FILE [--top N]
+       [--cluster | --routed]
 """
 
 from __future__ import annotations
@@ -26,14 +37,45 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+class TraceLoadError(Exception):
+    """The input is not a readable Chrome trace-event file."""
+
+
 def load_all(path_or_file) -> list[dict]:
-    """Every event in a trace file (spans, instants, counters, metadata)."""
-    if hasattr(path_or_file, "read"):
-        doc = json.load(path_or_file)
-    else:
-        with open(path_or_file) as f:
-            doc = json.load(f)
-    return doc["traceEvents"] if isinstance(doc, dict) else doc
+    """Every event in a trace file (spans, instants, counters, metadata).
+
+    Raises :class:`TraceLoadError` — never a bare decode traceback — on
+    a missing/unreadable file, malformed or truncated JSON, or JSON of
+    the wrong shape (satellite: tooling must fail named, not crash)."""
+    name = getattr(path_or_file, "name", str(path_or_file))
+    try:
+        if hasattr(path_or_file, "read"):
+            doc = json.load(path_or_file)
+        else:
+            with open(path_or_file) as f:
+                doc = json.load(f)
+    except json.JSONDecodeError as e:
+        raise TraceLoadError(
+            f"{name}: malformed or truncated trace JSON ({e})"
+        ) from None
+    except UnicodeDecodeError:
+        raise TraceLoadError(f"{name}: not a text JSON file") from None
+    except OSError as e:
+        raise TraceLoadError(f"{name}: {e.strerror or e}") from None
+    if isinstance(doc, dict):
+        if "traceEvents" not in doc:
+            raise TraceLoadError(
+                f"{name}: JSON object has no 'traceEvents' key — not a "
+                "Chrome trace-event file"
+            )
+        doc = doc["traceEvents"]
+    if not isinstance(doc, list) or any(
+        not isinstance(e, dict) for e in doc
+    ):
+        raise TraceLoadError(
+            f"{name}: trace events must be a list of objects"
+        )
+    return doc
 
 
 def load_events(path_or_file) -> list[dict]:
@@ -250,11 +292,14 @@ def service_report(spans: list[dict]) -> list[str]:
             durs = sorted(by_lane[lane])
             p95 = durs[max(0, math.ceil(0.95 * len(durs)) - 1)]
             w = sorted(waits.get(lane, []))
-            wp95 = w[max(0, math.ceil(0.95 * len(w)) - 1)] if w else 0.0
+            # no observations is "-", never a fake 0.0 percentile
+            wp95 = w[max(0, math.ceil(0.95 * len(w)) - 1)] if w else None
+            wp95_s = f"{wp95 / 1e3:>12.3f}" if wp95 is not None \
+                else f"{'-':>12}"
             lines.append(
                 f"  {lane:<6} {len(durs):>6} "
                 f"{sum(durs) / len(durs) / 1e3:>9.3f} {p95 / 1e3:>9.3f} "
-                f"{max(durs) / 1e3:>9.3f} {wp95 / 1e3:>12.3f}"
+                f"{max(durs) / 1e3:>9.3f} {wp95_s}"
             )
     return lines
 
@@ -324,6 +369,158 @@ def router_report(spans: list[dict]) -> list[str]:
                 f"{max(durs) / 1e3:>9.3f}  {outs}"
             )
     return lines
+
+
+def routed_report(events: list[dict]) -> str:
+    """The fleet view of a merged router trace (pure function, ISSUE 12).
+
+    Expects the stream a tracing :class:`~sieve.service.router.SieveRouter`
+    writes: its own ``rpc.route``/``route.scatter`` spans plus per-shard-
+    replica tracks (``process_name`` = ``"shard<i> <addr>"``) carrying the
+    rebased ``rpc.query`` (and queue-wait/cold) children shipped back on
+    reply piggybacks, with one ``clock.align`` instant per merge.
+
+    Correlation is by trace-context prefix: a shard ``rpc.query`` with
+    ``args.ctx = R/s1.3.0`` is a child of the ``rpc.route`` whose
+    ``args.ctx = R``. Point queries have exactly one child; scatters have
+    one per shard touched."""
+    spans = sorted(
+        (e for e in events if e.get("ph") == "X"), key=lambda e: e["ts"]
+    )
+    replica_pids = {
+        e["pid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M"
+        and e.get("name") == "process_name"
+        and str(e.get("args", {}).get("name", "")).startswith("shard")
+    }
+    route = [e for e in spans if e["name"] == "rpc.route"]
+    if not route:
+        return (
+            "no rpc.route spans in trace — not a router trace "
+            "(python -m sieve route with --trace)"
+        )
+    if not replica_pids:
+        return (
+            "no shard-replica tracks in trace — shards did not piggyback "
+            "telemetry (start them with SIEVE_SVC_TELEMETRY=1), or every "
+            "payload was dropped"
+        )
+    lines: list[str] = []
+    wall = wall_span_us(spans)
+    queries = [
+        e for e in spans
+        if e["name"] == "rpc.query" and e.get("pid") in replica_pids
+    ]
+    lines.append(
+        f"routed-query fleet timeline: {len(replica_pids)} shard-replica "
+        f"tracks, {len(route)} rpc.route spans, {len(queries)} merged "
+        f"shard rpc.query spans over {wall / 1e3:.1f} ms"
+    )
+
+    # --- route -> child correlation by ctx prefix ---------------------------
+    by_ctx: dict[str, list[dict]] = {}
+    for q in queries:
+        ctx = str(q.get("args", {}).get("ctx", ""))
+        if ctx:
+            # child ctx "R/s<i>.<call>.<attempt>" -> route ctx "R"
+            base = ctx.rsplit("/", 1)[0]
+            by_ctx.setdefault(base, []).append(q)
+    correlated = exactly_one = nested = 0
+    for r in route:
+        rctx = str(r.get("args", {}).get("ctx", ""))
+        kids = by_ctx.get(rctx, []) if rctx else []
+        if not kids:
+            continue
+        correlated += 1
+        if len(kids) == 1:
+            exactly_one += 1
+        if all(
+            k["ts"] >= r["ts"]
+            and k["ts"] + k["dur"] <= r["ts"] + r["dur"]
+            for k in kids
+        ):
+            nested += 1
+    lines.append(
+        f"correlation: {correlated}/{len(route)} rpc.route spans have "
+        f"shard rpc.query children "
+        f"({100 * correlated / len(route):.1f}%); "
+        f"{exactly_one} with exactly one child; nested after rebase: "
+        f"{nested}/{correlated} "
+        f"({100 * nested / correlated if correlated else 0:.1f}%)"
+    )
+
+    # --- per-replica tracks -------------------------------------------------
+    lines.append("")
+    lines.append("per-replica tracks (merged rpc.query spans):")
+    lines.append(
+        f"  {'replica':<28} {'spans':>6} {'mean ms':>9} {'p95 ms':>9} "
+        f"{'max ms':>9}"
+    )
+    for pid in sorted(replica_pids):
+        durs = sorted(e["dur"] for e in queries if e.get("pid") == pid)
+        if durs:
+            p95 = durs[max(0, math.ceil(0.95 * len(durs)) - 1)]
+            lines.append(
+                f"  {replica_pids[pid]:<28} {len(durs):>6} "
+                f"{sum(durs) / len(durs) / 1e3:>9.3f} {p95 / 1e3:>9.3f} "
+                f"{durs[-1] / 1e3:>9.3f}"
+            )
+        else:
+            lines.append(
+                f"  {replica_pids[pid]:<28} {0:>6} {'-':>9} {'-':>9} "
+                f"{'-':>9}"
+            )
+
+    # --- clock alignment ----------------------------------------------------
+    lines.append("")
+    aligns = [
+        e for e in events
+        if e.get("name") == "clock.align"
+        and "replica" in e.get("args", {})
+    ]
+    if aligns:
+        lines.append("per-replica clock alignment (min-RTT estimate, "
+                     "error bound = RTT/2):")
+        latest: dict[str, dict] = {}
+        for e in sorted(aligns, key=lambda e: e.get("ts", 0)):
+            latest[str(e["args"]["replica"])] = e["args"]
+        max_err = None
+        total_dropped = 0
+        for rep in sorted(latest):
+            a = latest[rep]
+            total_dropped += a.get("dropped", 0)
+            if "offset_s" in a:
+                max_err = (
+                    a["err_s"] if max_err is None
+                    else max(max_err, a["err_s"])
+                )
+                lines.append(
+                    f"  shard{a.get('shard', '?')} {rep}: offset "
+                    f"{a['offset_s'] * 1e3:+.3f} ms, rtt "
+                    f"{a['rtt_s'] * 1e3:.3f} ms, err <= "
+                    f"{a['err_s'] * 1e6:.0f} us "
+                    f"({a.get('samples', 0)} samples, "
+                    f"{a.get('dropped', 0)} events dropped)"
+                )
+            else:
+                lines.append(
+                    f"  shard{a.get('shard', '?')} {rep}: no alignment "
+                    "sample (events merged unrebased)"
+                )
+        if max_err is not None:
+            lines.append(
+                f"  max clock-alignment error: {max_err * 1e6:.0f} us"
+            )
+        if total_dropped:
+            lines.append(
+                f"  WARNING: {total_dropped} shard trace events dropped "
+                "by the ship ring (raise SIEVE_TELEMETRY_RING)"
+            )
+    else:
+        lines.append("clock alignment: no replica clock.align events "
+                     "in trace")
+    return "\n".join(lines)
 
 
 def cluster_report(events: list[dict], top: int = 10) -> str:
@@ -528,11 +725,24 @@ def main(argv: list[str] | None = None) -> int:
                    help="distributed view of a merged cpu-cluster trace: "
                         "per-worker utilization, rpc-wait vs compute, "
                         "stragglers, clock-alignment error")
+    p.add_argument("--routed", action="store_true",
+                   help="fleet view of a merged router trace: rpc.route "
+                        "<-> shard rpc.query correlation, per-replica "
+                        "tracks, clock-alignment error")
     args = p.parse_args(argv)
+    try:
+        events = load_all(args.trace_file)
+    except TraceLoadError as e:
+        print(f"trace_report: error: {e}", file=sys.stderr)
+        return 1
     if args.cluster:
-        print(cluster_report(load_all(args.trace_file), top=args.top))
+        print(cluster_report(events, top=args.top))
         return 0
-    spans = load_events(args.trace_file)
+    if args.routed:
+        print(routed_report(events))
+        return 0
+    spans = sorted((e for e in events if e.get("ph") == "X"),
+                   key=lambda e: e["ts"])
     if not spans:
         print("no span events in trace", file=sys.stderr)
         return 1
