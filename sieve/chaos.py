@@ -105,6 +105,19 @@ sequence like the other ``svc_*`` request kinds):
                               with a counted ``router_trace_gap`` event,
                               never an error.
 
+Flight recorder (ISSUE 13):
+
+* ``svc_crash:any@sK``        request K's worker thread raises uncaught
+                              (:class:`ChaosCrash` deliberately escapes
+                              the handler's catch-all nets): the worker
+                              dies, ``threading.excepthook`` fires the
+                              recorder's crash trigger (writing a debug
+                              bundle under ``--debug-dir``), and the
+                              replica's surviving workers keep
+                              answering. The crashed request itself
+                              never gets a reply — from the client's
+                              side it is a dead-worker timeout.
+
 ``worker`` is an integer id, or ``any``/``*`` for whichever worker draws
 the segment (the pull model makes a specific id probabilistic, ``any``
 deterministic). Directives are transported to the worker inside the
@@ -139,6 +152,7 @@ KINDS = (
     "svc_flood",
     "svc_shard_down",
     "svc_trace_drop",
+    "svc_crash",
 )
 # kinds handled by the query service (sieve/service/); the cluster plane
 # ignores these and vice versa. Request-scoped kinds key on the request
@@ -156,6 +170,7 @@ SERVICE_KINDS = (
     "svc_batch_partial",
     "svc_flood",
     "svc_trace_drop",
+    "svc_crash",
 )
 SERVICE_REQUEST_KINDS = (
     "svc_stall",
@@ -165,6 +180,7 @@ SERVICE_REQUEST_KINDS = (
     "svc_drain",
     "svc_flood",
     "svc_trace_drop",
+    "svc_crash",
 )
 # drawn by the router tier (ISSUE 11) on ITS request sequence; the
 # directive's worker field names a shard index there, so shard servers
@@ -193,7 +209,15 @@ DEFAULT_PARAM: dict[str, float | str | None] = {
     # param = seconds the shard stays unreachable to the router
     "svc_shard_down": 1.0,
     "svc_trace_drop": None,
+    "svc_crash": None,
 }
+
+
+class ChaosCrash(RuntimeError):
+    """Raised by the ``svc_crash`` directive. Deliberately re-raised
+    past the service handler's catch-all nets so the worker thread
+    genuinely dies and the flight recorder's ``threading.excepthook``
+    crash trigger fires (ISSUE 13)."""
 
 
 @dataclasses.dataclass(frozen=True)

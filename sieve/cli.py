@@ -231,6 +231,13 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace", default=None, dest="trace_file", metavar="FILE",
                    help="write rpc.query / queue-wait / materialize / cold "
                         "spans as Chrome trace-event JSON on shutdown")
+    p.add_argument("--debug-dir", default=None, dest="debug_dir",
+                   help="flight-recorder bundle directory: edge triggers "
+                        "(SLO burn, breaker open, crash) freeze a "
+                        "timestamped postmortem bundle here (default "
+                        "SIEVE_SVC_DEBUG_DIR; without a dir the recorder "
+                        "still runs and serves the debug wire op / "
+                        "tools/fleet_debug.py inline)")
     p.add_argument("--metrics-file", default=None, dest="metrics_file")
     p.add_argument("--quiet", action="store_true",
                    help="suppress per-request stderr event lines")
@@ -275,6 +282,8 @@ def _serve(argv: list[str]) -> int:
             raise ValueError("--persist-cold needs --checkpoint-dir (the "
                              "ledger is the write-back target)")
         overrides["persist_cold"] = True
+    if args.debug_dir is not None:
+        overrides["debug_dir"] = args.debug_dir
     settings = ServiceSettings.from_env(**overrides)
 
     file_sink = None
@@ -366,6 +375,10 @@ def build_route_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace", default=None, dest="trace_file", metavar="FILE",
                    help="write rpc.route / route.scatter spans as Chrome "
                         "trace-event JSON on shutdown")
+    p.add_argument("--debug-dir", default=None, dest="debug_dir",
+                   help="flight-recorder bundle directory: a shard going "
+                        "dark (router_shard_down) or a crash freezes a "
+                        "timestamped postmortem bundle here")
     p.add_argument("--metrics-file", default=None, dest="metrics_file")
     p.add_argument("--quiet", action="store_true",
                    help="suppress per-request stderr event lines")
@@ -401,6 +414,8 @@ def _route(argv: list[str]) -> int:
         overrides["wire_chaos"] = True
     if args.quiet:
         overrides["quiet"] = True
+    if args.debug_dir is not None:
+        overrides["debug_dir"] = args.debug_dir
     settings = RouterSettings(**overrides)
 
     file_sink = None

@@ -26,9 +26,15 @@ sinks receive *every* record regardless of quiet.
 
 from __future__ import annotations
 
+import collections
+import itertools
 import json
+import math
+import os
+import random
 import sys
 import threading
+import zlib
 from typing import TYPE_CHECKING, Any, TextIO
 
 from sieve import trace
@@ -120,6 +126,11 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "router_trace_gap": {"shard", "reason"},
     "router_telemetry": {"shard", "replica", "events", "dropped"},
     "service_slo_burn": {"op", "p95_ms", "slo_ms", "window"},
+    # flight recorder (ISSUE 13): one debug_bundle per frozen postmortem
+    # bundle — "trigger" names the edge that fired (slo_burn /
+    # breaker_open / shard_down / crash), "path" the bundle directory
+    # (None when no --debug-dir is set and the freeze stayed in memory)
+    "debug_bundle": {"trigger", "path"},
 }
 
 
@@ -184,18 +195,40 @@ class Gauge:
         return {"type": "gauge", "value": self.value}
 
 
+# Fixed reservoir size (ISSUE 13): bounds a long-lived server's
+# histogram memory while keeping p50/p95/p99 within ~±2% — at 4096
+# samples the nearest-rank p99's rank error is ~0.16% (one sigma).
+HISTOGRAM_RESERVOIR = 4096
+
+
+def _pctile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted non-empty list
+    (same convention as bench.py and the server's SLO windows)."""
+    return sorted_vals[max(0, math.ceil(q * len(sorted_vals)) - 1)]
+
+
 class Histogram:
-    """Streaming summary: count/sum/min/max (no buckets — the sieve's
-    distributions are summarized, full timelines belong in ``--trace``)."""
+    """Streaming summary (count/sum/min/max) plus a fixed-size
+    reservoir for percentiles.
 
-    __slots__ = ("name", "count", "sum", "min", "max", "_lock")
+    Observations beyond the reservoir size replace a uniformly random
+    slot (Algorithm R), so memory stays bounded on long-lived servers
+    while p50/p95/p99 stay within a couple of percent of the true
+    distribution. The replacement stream is seeded from the metric
+    name: snapshots are reproducible run to run."""
 
-    def __init__(self, name: str):
+    __slots__ = ("name", "count", "sum", "min", "max", "_lock",
+                 "_reservoir", "_cap", "_rng")
+
+    def __init__(self, name: str, reservoir: int = HISTOGRAM_RESERVOIR):
         self.name = name
         self.count = 0
         self.sum = 0.0
         self.min: float | None = None
         self.max: float | None = None
+        self._reservoir: list[float] = []
+        self._cap = max(1, reservoir)
+        self._rng = random.Random(zlib.crc32(name.encode()))
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
@@ -206,15 +239,28 @@ class Histogram:
                 self.min = v
             if self.max is None or v > self.max:
                 self.max = v
+            if len(self._reservoir) < self._cap:
+                self._reservoir.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self._cap:
+                    self._reservoir[j] = v
 
     def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            count, total = self.count, self.sum
+            lo, hi = self.min, self.max
+            vals = sorted(self._reservoir)
         return {
             "type": "histogram",
-            "count": self.count,
-            "sum": self.sum,
-            "min": self.min,
-            "max": self.max,
-            "mean": self.sum / self.count if self.count else None,
+            "count": count,
+            "sum": total,
+            "min": lo,
+            "max": hi,
+            "mean": total / count if count else None,
+            "p50": _pctile(vals, 0.50) if vals else None,
+            "p95": _pctile(vals, 0.95) if vals else None,
+            "p99": _pctile(vals, 0.99) if vals else None,
         }
 
 
@@ -256,6 +302,149 @@ _REGISTRY = MetricsRegistry()
 
 def registry() -> MetricsRegistry:
     return _REGISTRY
+
+
+# --- metrics history (ISSUE 13) ----------------------------------------------
+
+# two-tier ring shape: the newest HISTORY_RECENT samples stay dense (one
+# per tick); as a sample ages out of the dense tier every
+# HISTORY_DECIMATE-th one is promoted into a coarse tier of
+# HISTORY_COARSE slots — an hour of 1 s sampling costs ~660 snapshots,
+# not 3600, and trend queries still see the whole hour.
+HISTORY_RECENT = 300
+HISTORY_COARSE = 360
+HISTORY_DECIMATE = 10
+
+
+def sample_interval_s() -> float:
+    """The MetricsHistory tick from ``SIEVE_METRICS_SAMPLE_S`` (seconds;
+    default 1.0; 0 disables sampling). Parse failures name the env var."""
+    raw = os.environ.get("SIEVE_METRICS_SAMPLE_S")
+    if raw is None:
+        return 1.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"env SIEVE_METRICS_SAMPLE_S={raw!r}: expected a number of "
+            "seconds (0 disables sampling)"
+        ) from None
+    if v < 0 or not math.isfinite(v):
+        raise ValueError(
+            f"env SIEVE_METRICS_SAMPLE_S={raw!r}: must be a non-negative "
+            "finite number of seconds"
+        )
+    return v
+
+
+class MetricsHistory:
+    """Daemon sampler: periodic registry snapshots into a bounded,
+    time-downsampled ring.
+
+    This is the trend input the flight recorder bundles and a future
+    SLO-driven autoscaler reads (ROADMAP elasticity item): recent
+    samples dense, older samples decimated, memory bounded regardless
+    of process lifetime. ``start``/``stop`` are idempotent; a 0 sample
+    interval disables the sampler entirely (zero samples, zero
+    threads); ``stop`` takes one final synchronous sample so whatever
+    changed since the last timer tick is not lost."""
+
+    def __init__(
+        self,
+        reg: MetricsRegistry | None = None,
+        sample_s: float | None = None,
+        recent: int = HISTORY_RECENT,
+        coarse: int = HISTORY_COARSE,
+        decimate: int = HISTORY_DECIMATE,
+    ):
+        self._reg = reg if reg is not None else registry()
+        self.sample_s = (
+            sample_interval_s() if sample_s is None else float(sample_s)
+        )
+        self._recent: collections.deque = collections.deque(maxlen=recent)
+        self._coarse: collections.deque = collections.deque(maxlen=coarse)
+        self._decimate = max(1, decimate)
+        self._taken = 0
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --- lifecycle -------------------------------------------------------
+
+    def start(self) -> "MetricsHistory":
+        if self.sample_s <= 0:
+            return self  # disabled: no thread, no samples
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self  # idempotent
+            self._stop_evt = threading.Event()
+            self._thread = threading.Thread(
+                target=self._loop, args=(self._stop_evt,), daemon=True,
+                name="metrics-history",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            t, self._thread = self._thread, None
+            self._stop_evt.set()
+        if t is not None:
+            t.join(timeout=5)
+            self.sample_now()  # drain-on-stop: the partial tick lands
+
+    def _loop(self, stop_evt: threading.Event) -> None:
+        while not stop_evt.wait(self.sample_s):
+            self.sample_now()
+
+    # --- sampling --------------------------------------------------------
+
+    def sample_now(self) -> float:
+        """Take one sample immediately (the timer thread's tick body;
+        also the test/drain hook). Returns the sample timestamp."""
+        snap = self._reg.snapshot()
+        ts = round(trace.now_s(), 4)
+        with self._lock:
+            self._taken += 1
+            if len(self._recent) == self._recent.maxlen:
+                aged = self._recent[0]  # about to be evicted by append
+                if aged[2] % self._decimate == 0:
+                    self._coarse.append(aged)
+            self._recent.append((ts, snap, self._taken))
+        return ts
+
+    @property
+    def samples(self) -> int:
+        """Samples ever taken (monotonic; survives ring eviction)."""
+        with self._lock:
+            return self._taken
+
+    # --- queries ---------------------------------------------------------
+
+    def rows(self, window_s: float | None = None) -> list[tuple[float, dict]]:
+        """Raw ``(ts, registry-snapshot)`` rows, oldest first (coarse
+        tier then dense), optionally limited to the trailing window —
+        the flight recorder bundles this verbatim."""
+        cutoff = None if window_s is None else trace.now_s() - window_s
+        with self._lock:
+            rows = list(itertools.chain(self._coarse, self._recent))
+        return [
+            (ts, snap) for ts, snap, _ in rows
+            if cutoff is None or ts >= cutoff
+        ]
+
+    def history(self, name: str, window_s: float) -> list[tuple[float, Any]]:
+        """Trend rows for one instrument over the trailing window:
+        ``(ts, value)`` for counters and gauges, ``(ts, snapshot-dict)``
+        for histograms. Samples predating the instrument's registration
+        are absent, not None — registry churn is expected."""
+        out: list[tuple[float, Any]] = []
+        for ts, snap in self.rows(window_s):
+            inst = snap.get(name)
+            if inst is None:
+                continue
+            out.append((ts, inst["value"] if "value" in inst else inst))
+        return out
 
 
 # --- sinks -------------------------------------------------------------------

@@ -52,6 +52,19 @@ sheds, and per-shard failover compose through the fabric. Shard servers
 run with ``--range-lo`` and refuse global-prefix ops — composition is
 the router's job. ``python -m sieve route`` is the CLI front door; the
 ``svc_shard_down`` chaos kind drills whole-shard outages.
+
+Flight recorder (ISSUE 13): every server and router runs a
+:class:`~sieve.debug.FlightRecorder` — a black box continuously
+holding the span-ring tail, the last structured events, the bounded
+:class:`~sieve.metrics.MetricsHistory` trend window, and a redacted
+config. Edge triggers (SLO burn, circuit-breaker open,
+``router_shard_down``, crash) freeze it into a timestamped bundle
+under ``--debug-dir``, one per trigger kind per cooldown; the
+``debug`` wire op snapshots the same state inline, and
+``tools/fleet_debug.py`` merges router + every replica into one fleet
+bundle that ``tools/trace_report.py --bundle`` renders. The
+``svc_crash`` chaos kind kills a worker thread for real to drill the
+crash path.
 """
 
 from sieve.service.client import (

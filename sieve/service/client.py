@@ -183,6 +183,14 @@ class ServiceClient:
         """Full metrics-registry snapshot (ISSUE 12 live telemetry op)."""
         return self._call({"type": "metrics"})["metrics"]
 
+    def debug(self) -> dict | None:
+        """Inline flight-recorder bundle (ISSUE 13 postmortem op).
+
+        Answered by the reader thread like ``metrics``, so it works
+        against a server whose worker pool is wedged. None when the
+        endpoint runs with the recorder disabled."""
+        return self._call({"type": "debug"})["bundle"]
+
     def inject_chaos(self, spec: str) -> dict:
         return self._call({"type": "chaos", "spec": spec})
 

@@ -74,7 +74,8 @@ from sieve.chaos import (
     parse_chaos,
 )
 from sieve.enumerate import MAX_HI
-from sieve.metrics import MetricsLogger, registry
+from sieve.debug import FlightRecorder
+from sieve.metrics import MetricsHistory, MetricsLogger, registry
 from sieve.rpc import parse_addr, recv_msg, send_msg
 from sieve.service.client import CallTimeout, ReplicaSet, ServiceError
 from sieve.service.server import BadRequest, DeadlineExceeded, Draining
@@ -128,6 +129,13 @@ class RouterSettings:
     drain_s: float = 5.0
     wire_chaos: bool = False
     quiet: bool = False
+    # flight recorder (ISSUE 13): same black box as ServiceSettings —
+    # shard_down is the router's edge trigger; debug_dir is where
+    # bundles freeze (None = inline-only via the ``debug`` wire op)
+    recorder: bool = True
+    debug_dir: str | None = None
+    debug_cooldown_s: float = 30.0
+    metrics_sample_s: float = 1.0
 
     def validate(self) -> "RouterSettings":
         for name in ("default_deadline_s", "timeout_s", "probe_timeout_s"):
@@ -138,7 +146,8 @@ class RouterSettings:
                     f"router settings: {name}={v!r} must be a positive "
                     "number"
                 )
-        for name in ("probe_ttl_s", "drain_s"):
+        for name in ("probe_ttl_s", "drain_s", "debug_cooldown_s",
+                     "metrics_sample_s"):
             v = getattr(self, name)
             if not isinstance(v, (int, float)) or isinstance(v, bool) \
                     or v < 0 or not math.isfinite(v):
@@ -257,6 +266,20 @@ class SieveRouter:
         self._inflight_lock = threading.Lock()
         self.drain_event = threading.Event()
         self._drained = threading.Event()
+        # flight recorder (ISSUE 13): armed in start(); router_shard_down
+        # is the router's edge trigger
+        self.history: MetricsHistory | None = None
+        self.recorder: FlightRecorder | None = None
+        if s.recorder:
+            self.history = MetricsHistory(sample_s=s.metrics_sample_s)
+            self.recorder = FlightRecorder(
+                "router",
+                debug_dir=s.debug_dir,
+                history=self.history,
+                config=s,
+                logger=self.metrics,
+                cooldown_s=s.debug_cooldown_s,
+            )
 
     # --- lifecycle -------------------------------------------------------
 
@@ -276,6 +299,9 @@ class SieveRouter:
                              name="router-accept")
         t.start()
         self._threads.append(t)
+        if self.recorder is not None:
+            self.history.start()
+            self.recorder.install()
         return self
 
     def drain(self) -> None:
@@ -342,6 +368,9 @@ class SieveRouter:
                     pass
         for rs in self.sets:
             rs.close()
+        if self.recorder is not None:
+            self.recorder.uninstall()
+            self.history.stop()
         self._drained.set()
 
     def __enter__(self) -> "SieveRouter":
@@ -385,10 +414,14 @@ class SieveRouter:
                             self._down_until.get(t, 0.0), now + secs
                         )
                     self._bump("shard_down_windows")
+                    reason = f"chaos svc_shard_down ({secs}s)"
                     self.metrics.event(
-                        "router_shard_down", shard=t,
-                        reason=f"chaos svc_shard_down ({secs}s)",
+                        "router_shard_down", shard=t, reason=reason,
                     )
+                    if self.recorder is not None:
+                        self.recorder.trigger(
+                            "shard_down", shard=t, reason=reason,
+                        )
 
     def _check_shard_up(self, i: int) -> None:
         with self._down_lock:
@@ -871,6 +904,14 @@ class SieveRouter:
                          "role": "router",
                          "metrics": registry().snapshot()})
             return
+        if mtype == "debug":
+            # flight-recorder freeze (ISSUE 13): inline like metrics
+            self._reply(conn, send_lock, {
+                "type": "debug", "id": rid, "ok": True, "role": "router",
+                "bundle": (self.recorder.snapshot("manual")
+                           if self.recorder is not None else None),
+            })
+            return
         if mtype == "shutdown":
             self._reply(conn, send_lock,
                         {"type": "reply", "id": rid, "ok": True,
@@ -990,6 +1031,9 @@ class SieveRouter:
             self._bump("unavailable_replies")
             self.metrics.event("router_shard_down", shard=e.shard,
                                reason=e.reason)
+            if self.recorder is not None:
+                self.recorder.trigger("shard_down", shard=e.shard,
+                                      reason=e.reason)
         except DeadlineExceeded as e:
             outcome = "deadline_exceeded"
             rctx.answered_hi = max(rctx.answered_hi, e.answered_hi)

@@ -86,7 +86,13 @@ import numpy as np
 
 from sieve import trace
 from sieve.backends import make_worker
-from sieve.chaos import SERVICE_REQUEST_KINDS, ChaosSchedule, parse_chaos
+from sieve.chaos import (
+    SERVICE_REQUEST_KINDS,
+    ChaosCrash,
+    ChaosSchedule,
+    parse_chaos,
+)
+from sieve.debug import FlightRecorder
 from sieve.checkpoint import (
     COLD_SEG_BASE,
     Ledger,
@@ -94,7 +100,7 @@ from sieve.checkpoint import (
     ledger_fingerprint,
 )
 from sieve.enumerate import MAX_HI, primes_in_range
-from sieve.metrics import MetricsLogger, registry
+from sieve.metrics import MetricsHistory, MetricsLogger, registry, sample_interval_s
 from sieve.rpc import parse_addr, recv_msg, send_msg
 from sieve.seed import seed_primes
 from sieve.service.index import QueryCtx, SieveIndex
@@ -261,6 +267,18 @@ class ServiceSettings:
     # "burns" while its window p95 exceeds the target.
     slo_ms: dict[str, float] | None = None
     slo_window: int = 256
+    # flight recorder (ISSUE 13): continuous black-box capture (bounded
+    # deques — cheap enough to be on by default). debug_dir is where
+    # edge triggers (SLO burn, breaker open, crash) freeze timestamped
+    # postmortem bundles (None = inline-only, served by the ``debug``
+    # wire op); triggers throttle to one bundle per kind per cooldown.
+    # metrics_sample_s is the MetricsHistory trend-sampler tick
+    # (0 disables the sampler; the env spelling is the metrics-level
+    # SIEVE_METRICS_SAMPLE_S, shared with the cluster plane).
+    recorder: bool = True
+    debug_dir: str | None = None
+    debug_cooldown_s: float = 30.0
+    metrics_sample_s: float = 1.0
 
     def validate(self) -> "ServiceSettings":
         """Typed startup validation: every rejection names the setting
@@ -292,7 +310,8 @@ class ServiceSettings:
                 "must be a non-negative integer"
             )
         for name in ("refresh_s", "drain_s", "cold_delay_s", "cold_age_s",
-                     "breaker_cooldown_s"):
+                     "breaker_cooldown_s", "debug_cooldown_s",
+                     "metrics_sample_s"):
             v = getattr(self, name)
             if not isinstance(v, (int, float)) or isinstance(v, bool) \
                     or v < 0 or not math.isfinite(v):
@@ -326,6 +345,13 @@ class ServiceSettings:
             raise ValueError(
                 f"service settings: telemetry_batch={self.telemetry_batch!r} "
                 "must be a positive integer"
+            )
+        if self.debug_dir is not None and (
+            not isinstance(self.debug_dir, str) or not self.debug_dir
+        ):
+            raise ValueError(
+                f"service settings: debug_dir={self.debug_dir!r} must be a "
+                "non-empty path (or None)"
             )
         if self.slo_ms is not None:
             if not isinstance(self.slo_ms, dict):
@@ -391,6 +417,13 @@ class ServiceSettings:
             ),
             slo_ms=_slo_from_env(),
             slo_window=_env_int("SIEVE_SVC_SLO_WINDOW", cls.slo_window),
+            recorder=os.environ.get("SIEVE_SVC_RECORDER", "1")
+            not in ("0", "", "false"),
+            debug_dir=os.environ.get("SIEVE_SVC_DEBUG_DIR") or None,
+            debug_cooldown_s=_env_float(
+                "SIEVE_SVC_DEBUG_COOLDOWN_S", cls.debug_cooldown_s
+            ),
+            metrics_sample_s=sample_interval_s(),
         )
         return dataclasses.replace(s, **overrides)
 
@@ -920,6 +953,23 @@ class SieveService:
         self._slo_burning: set[str] = set()
         # telemetry shipping: armed in start() when telemetry_ship is on
         self._telemetry_on = False
+        # flight recorder (ISSUE 13): trend sampler + black-box capture,
+        # armed in start(); edge triggers (SLO burn, breaker open,
+        # crash) freeze bundles under settings.debug_dir
+        self.history: MetricsHistory | None = None
+        self.recorder: FlightRecorder | None = None
+        if self.settings.recorder:
+            self.history = MetricsHistory(
+                sample_s=self.settings.metrics_sample_s
+            )
+            self.recorder = FlightRecorder(
+                "service",
+                debug_dir=self.settings.debug_dir,
+                history=self.history,
+                config=config,
+                logger=self.metrics,
+                cooldown_s=self.settings.debug_cooldown_s,
+            )
 
     # --- lifecycle -------------------------------------------------------
 
@@ -982,6 +1032,9 @@ class SieveService:
                 tr.set_event_limit(ring)
                 tr.enable(clear=False)
                 self._telemetry_on = True
+        if self.recorder is not None:
+            self.history.start()
+            self.recorder.install()
         return self
 
     def drain(self) -> None:
@@ -1052,6 +1105,9 @@ class SieveService:
             t.join(timeout=5)
         self.batcher.stop()
         self.cold.close()
+        if self.recorder is not None:
+            self.recorder.uninstall()
+            self.history.stop()
         self._drained.set()
 
     def __enter__(self) -> "SieveService":
@@ -1107,6 +1163,10 @@ class SieveService:
                 "service_slo_burn", op=op, p95_ms=round(p95, 3),
                 slo_ms=target, window=len(vals),
             )
+            if self.recorder is not None:
+                self.recorder.trigger(
+                    "slo_burn", op=op, p95_ms=round(p95, 3), slo_ms=target,
+                )
 
     def _win_burn_locked(self, op: str) -> float:
         win = self._slo_windows.get(op)
@@ -1242,6 +1302,10 @@ class SieveService:
         self.metrics.event("service_degraded", entering=entering,
                            reason=reason)
         registry().gauge("service.degraded").set(1.0 if entering else 0.0)
+        if entering and self.recorder is not None:
+            # circuit breaker opened: the minutes before are exactly
+            # what a postmortem needs — freeze them now
+            self.recorder.trigger("breaker_open", reason=reason)
 
     def inject_chaos(self, spec: str) -> int:
         """Extend the live schedule (the ``chaos`` wire op / tests)."""
@@ -1340,6 +1404,16 @@ class SieveService:
             self._reply(conn, send_lock, {
                 "type": "metrics", "id": rid, "ok": True,
                 "role": "service", "metrics": registry().snapshot(),
+            })
+            return None
+        if mtype == "debug":
+            # flight-recorder freeze (ISSUE 13): answered inline by the
+            # reader thread like metrics, so a wedged worker pool still
+            # dumps its black box (no disk write, no throttle)
+            self._reply(conn, send_lock, {
+                "type": "debug", "id": rid, "ok": True, "role": "service",
+                "bundle": (self.recorder.snapshot("manual")
+                           if self.recorder is not None else None),
             })
             return None
         if mtype == "telemetry":
@@ -1562,6 +1636,8 @@ class SieveService:
                 return
             try:
                 self._handle(*item)
+            except ChaosCrash:
+                raise  # svc_crash drill: this worker thread must die
             except Exception:
                 pass  # _handle replies "internal" itself; never die
 
@@ -1610,6 +1686,10 @@ class SieveService:
                 elif d["kind"] == "backend_down":
                     self.cold.force_down(float(d["param"] or 0.0),
                                          "chaos backend_down")
+                elif d["kind"] == "svc_crash":
+                    raise ChaosCrash(
+                        f"chaos svc_crash: worker killed mid-{op or 'query'}"
+                    )
             check()
             reply["value"] = self._execute(op, msg, ctx, deadline, idx)
         except _Demoted as e:
@@ -1634,6 +1714,15 @@ class SieveService:
                           "hot query; retry",
                 "partial": None,
             }
+        except ChaosCrash:
+            # svc_crash drill: this request will never reply, so settle
+            # its drain accounting here, then let the exception escape
+            # both catch-all nets — the worker thread must genuinely die
+            # so threading.excepthook (the recorder's crash hook) fires
+            with self._inflight_lock:
+                self._inflight_n -= 1
+            self._maybe_drained()
+            raise
         except tuple(_ERROR_KIND) as e:
             outcome = _ERROR_KIND[type(e)]
             reply = {

@@ -312,6 +312,15 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
+    def tail(self, n: int) -> list[dict]:
+        """The newest ``n`` captured events without draining the ring —
+        the flight recorder's span tail (ISSUE 13). Empty when capture
+        is off or ``n`` <= 0."""
+        if n <= 0:
+            return []
+        with self._lock:
+            return self._events[-n:]
+
     def save(self, path_or_file: str | TextIO) -> None:
         """Write the captured events as Chrome trace-event JSON."""
         doc = {"traceEvents": self.events(), "displayTimeUnit": "ms"}
@@ -443,6 +452,10 @@ def pending_events() -> int:
 
 def ingest(events: list[dict]) -> None:
     _TRACER.ingest(events)
+
+
+def tail(n: int) -> list[dict]:
+    return _TRACER.tail(n)
 
 
 def snapshot() -> dict[str, tuple[float, int]]:

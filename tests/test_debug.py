@@ -1,0 +1,624 @@
+"""Black-box flight recorder: metrics history, triggered debug
+bundles, and fleet-wide postmortem collection (ISSUE 13).
+
+Covers: the bounded Histogram reservoir (memory bound + p50/p95/p99
+accuracy); MetricsHistory lifecycle (idempotent start/stop, registry
+churn, disabled sampler, drain-on-stop) and two-tier downsampling;
+FlightRecorder snapshot/trigger/cooldown/crash-hook/redaction; the
+``svc_crash`` chaos kind (grammar + a worker genuinely dying + the
+crash bundle); the ``debug`` wire op on server and router; the
+shard_down and slo_burn bundle triggers; ``tools/check_event_schema``
+(tier-1 schema honesty); ``tools/fleet_top --json`` exit codes;
+``tools/trace_report --bundle`` guards; and the acceptance E2E — a
+2-shard subprocess fleet under SLO burn plus one svc_crash produces
+bundles on the affected replica and the router, tools/fleet_debug.py
+merges >= 3 processes into ONE fleet bundle, and trace_report
+--bundle renders it, with query results exact throughout.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sieve import metrics
+from sieve.chaos import ChaosCrash, parse_chaos
+from sieve.checkpoint import Ledger
+from sieve.config import SieveConfig
+from sieve.coordinator import run_local
+from sieve.debug import BUNDLE_VERSION, FLEET_BUNDLE_VERSION, FlightRecorder, redact
+from sieve.metrics import (
+    HISTOGRAM_RESERVOIR,
+    Histogram,
+    MetricsHistory,
+    MetricsRegistry,
+    sample_interval_s,
+)
+from sieve.seed import seed_primes
+from sieve.service import (
+    RouterSettings,
+    ServiceClient,
+    ServiceSettings,
+    Shard,
+    ShardMap,
+    SieveRouter,
+    SieveService,
+)
+from sieve.service.client import CallTimeout
+
+REPO = Path(__file__).resolve().parent.parent
+
+N = 50_000
+P = seed_primes(200_000)
+
+
+def o_pi(x):
+    return int(np.searchsorted(P, x, side="right"))
+
+
+def o_count(lo, hi):
+    return int(np.searchsorted(P, hi, side="left")
+               - np.searchsorted(P, lo, side="left"))
+
+
+def _cfg(checkpoint_dir, **kw):
+    base = dict(
+        n=N, backend="cpu-numpy", packing="wheel30", n_segments=4,
+        quiet=True, checkpoint_dir=checkpoint_dir,
+    )
+    base.update(kw)
+    return SieveConfig(**base)
+
+
+def _settings(**kw):
+    base = dict(workers=2, queue_limit=16, default_deadline_s=10.0,
+                refresh_s=0.0, metrics_sample_s=0.0)
+    base.update(kw)
+    return ServiceSettings(**base)
+
+
+@pytest.fixture(scope="module")
+def src_dir(tmp_path_factory):
+    path = tmp_path_factory.mktemp("debug_src")
+    run_local(_cfg(str(path)))
+    return path
+
+
+def _split_shards(src_dir, tmp_path):
+    segs = sorted(
+        Ledger.open_readonly(_cfg(str(src_dir))).completed().values(),
+        key=lambda r: r.lo,
+    )
+    E = segs[2].lo
+    dirs = (tmp_path / "shard0", tmp_path / "shard1")
+    for d, part in zip(dirs, (segs[:2], segs[2:])):
+        led = Ledger.open(_cfg(str(d)))
+        for r in part:
+            led.record(r)
+    return str(dirs[0]), str(dirs[1]), E
+
+
+def _wait(cond, timeout_s=5.0, what="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# --- histogram reservoir (satellite) ----------------------------------------
+
+
+def test_histogram_reservoir_bound_and_percentile_accuracy():
+    h = Histogram("acc.test")
+    rng = random.Random(42)
+    n = 50_000
+    for _ in range(n):
+        h.observe(rng.uniform(0.0, 100.0))
+    # memory bound: the reservoir never exceeds its cap no matter how
+    # many observations stream through
+    assert len(h._reservoir) == HISTOGRAM_RESERVOIR < n
+    snap = h.snapshot()
+    assert snap["count"] == n
+    assert snap["min"] >= 0.0 and snap["max"] <= 100.0
+    # uniform [0, 100]: true quantile q is 100q; 2% of full scale
+    for key, true in (("p50", 50.0), ("p95", 95.0), ("p99", 99.0)):
+        assert abs(snap[key] - true) <= 2.0, f"{key}={snap[key]}"
+    assert snap["p50"] <= snap["p95"] <= snap["p99"] <= snap["max"]
+
+
+def test_histogram_deterministic_and_empty_snapshot_nulls():
+    a, b = Histogram("det.x"), Histogram("det.x")
+    for i in range(20_000):
+        v = float((i * 2654435761) % 1000)
+        a.observe(v)
+        b.observe(v)
+    # per-name seeded reservoir: identical streams -> identical stats
+    assert a.snapshot() == b.snapshot()
+    empty = Histogram("det.empty").snapshot()
+    for key in ("mean", "min", "max", "p50", "p95", "p99"):
+        assert empty[key] is None  # never a fake 0
+
+
+# --- MetricsHistory lifecycle (satellite) -----------------------------------
+
+
+def test_history_start_stop_idempotent_and_drain_on_stop():
+    reg = MetricsRegistry()
+    reg.counter("t.c").inc()
+    h = MetricsHistory(reg=reg, sample_s=0.01)
+    h.start()
+    first_thread = h._thread
+    h.start()  # idempotent: same sampler thread, not a second one
+    assert h._thread is first_thread
+    _wait(lambda: h.samples >= 3, what="3 samples")
+    # registry churn: an instrument born mid-flight appears in later rows
+    reg.counter("t.born_late").inc(5)
+    seen = h.samples
+    _wait(lambda: h.samples >= seen + 2, what="churn samples")
+    reg.counter("t.final_tick").inc()
+    h.stop()
+    taken = h.samples
+    assert taken >= 5
+    # drain-on-stop: the synchronous final sample caught the last bump
+    assert h.history("t.final_tick", 60.0)[-1][1] == 1
+    assert [v for _, v in h.history("t.born_late", 60.0)] \
+        and all(v == 5 for _, v in h.history("t.born_late", 60.0))
+    # pre-churn rows simply lack the instrument (absent, not None)
+    assert len(h.history("t.born_late", 60.0)) < len(h.rows())
+    h.stop()  # second stop: no thread, no extra sample
+    assert h.samples == taken
+    assert h._thread is None
+
+
+def test_history_disabled_takes_zero_samples():
+    reg = MetricsRegistry()
+    h = MetricsHistory(reg=reg, sample_s=0.0)
+    h.start()
+    assert h._thread is None
+    time.sleep(0.03)
+    assert h.samples == 0
+    h.stop()  # safe when disabled
+    assert h.samples == 0 and h.rows() == []
+
+
+def test_history_two_tier_downsampling_bounds_memory():
+    reg = MetricsRegistry()
+    g = reg.gauge("t.g")
+    h = MetricsHistory(reg=reg, sample_s=0.0, recent=4, coarse=8,
+                       decimate=2)
+    for i in range(20):
+        g.set(float(i))
+        h.sample_now()
+    assert h.samples == 20
+    rows = h.rows()
+    # dense tier: the newest 4; coarse tier: every 2nd evicted ordinal
+    assert len(rows) == 4 + 8
+    vals = [snap["t.g"]["value"] for _, snap in rows]
+    assert vals[-4:] == [16.0, 17.0, 18.0, 19.0]  # dense, newest last
+    assert vals[:8] == [1.0, 3.0, 5.0, 7.0, 9.0, 11.0, 13.0, 15.0]
+    assert [ts for ts, _ in rows] == sorted(ts for ts, _ in rows)
+
+
+def test_sample_interval_env(monkeypatch):
+    monkeypatch.delenv("SIEVE_METRICS_SAMPLE_S", raising=False)
+    assert sample_interval_s() == 1.0
+    monkeypatch.setenv("SIEVE_METRICS_SAMPLE_S", "0")
+    assert sample_interval_s() == 0.0
+    monkeypatch.setenv("SIEVE_METRICS_SAMPLE_S", "fast")
+    with pytest.raises(ValueError, match="SIEVE_METRICS_SAMPLE_S"):
+        sample_interval_s()
+    monkeypatch.setenv("SIEVE_METRICS_SAMPLE_S", "-1")
+    with pytest.raises(ValueError, match="non-negative"):
+        sample_interval_s()
+
+
+# --- FlightRecorder unit -----------------------------------------------------
+
+
+def test_redact_masks_secretish_keys_and_survives_non_json():
+    masked = redact({
+        "api_key": "hunter2",
+        "nested": {"auth_token": "x", "ok": 2},
+        "fine": [1, "two", None],
+        "obj": object(),
+    })
+    assert masked["api_key"] == "<redacted>"
+    assert masked["nested"]["auth_token"] == "<redacted>"
+    assert masked["nested"]["ok"] == 2
+    assert masked["fine"] == [1, "two", None]
+    assert isinstance(masked["obj"], str)  # repr, still JSON-able
+    json.dumps(masked)
+    # dataclasses flatten: settings configs ride along readably
+    flat = redact(RouterSettings())
+    assert isinstance(flat, dict) and "timeout_s" in flat
+
+
+def test_recorder_snapshot_trigger_cooldown_and_bundle_dir(tmp_path):
+    rec = FlightRecorder("service", debug_dir=str(tmp_path / "dbg"),
+                         cooldown_s=60.0, config={"n": 7, "token": "s3"})
+    rec.install()
+    try:
+        rec.emit({"event": "service_shed", "op": "pi"})
+        rec.emit({"event": "run", "n": 7})
+        snap = rec.snapshot()
+        assert snap["bundle"] == BUNDLE_VERSION
+        assert snap["role"] == "service" and snap["trigger"] == "manual"
+        assert snap["config"]["token"] == "<redacted>"
+        assert {"event": "service_shed", "op": "pi"} in snap["events"]
+        # "shed" is errorish, "run" is not
+        assert [e["event"] for e in snap["errors"]] == ["service_shed"]
+        for key in ("spans", "metrics", "history", "recorder", "pid"):
+            assert key in snap
+
+        b1 = rec.trigger("slo_burn", op="pi", p95_ms=9.0)
+        assert b1 is not None and b1["path"]
+        assert os.path.isfile(os.path.join(b1["path"], "bundle.json"))
+        with open(os.path.join(b1["path"], "bundle.json")) as f:
+            on_disk = json.load(f)
+        assert on_disk["trigger"] == "slo_burn"
+        assert on_disk["detail"] == {"op": "pi", "p95_ms": 9.0}
+        # same kind inside the cooldown: suppressed, counted, no dir
+        assert rec.trigger("slo_burn", op="pi") is None
+        assert rec.snapshot()["recorder"]["suppressed"] == 1
+        # a different kind is its own edge: fires immediately
+        b2 = rec.trigger("breaker_open", reason="cold errors")
+        assert b2 is not None and b2["path"] != b1["path"]
+        assert rec.snapshot()["recorder"]["bundles"] == 2
+    finally:
+        rec.uninstall()
+
+
+def test_recorder_crash_hook_fires_and_uninstall_restores(monkeypatch):
+    quiet_hook = lambda args: None  # noqa: E731 — silence the traceback
+    monkeypatch.setattr(threading, "excepthook", quiet_hook)
+    prev_sys = sys.excepthook
+    rec = FlightRecorder("service", cooldown_s=0.0)
+    rec.install()
+    try:
+        t = threading.Thread(target=lambda: 1 / 0, name="doomed")
+        t.start()
+        t.join()
+        _wait(lambda: rec.last_bundle is not None, what="crash bundle")
+        b = rec.last_bundle
+        assert b["trigger"] == "crash"
+        assert "ZeroDivisionError" in b["detail"]["error"]
+        assert b["detail"]["thread"] == "doomed"
+        assert b["path"] is None  # no debug_dir: in-memory only
+    finally:
+        rec.uninstall()
+    assert threading.excepthook is quiet_hook
+    assert sys.excepthook is prev_sys
+
+
+# --- svc_crash chaos ---------------------------------------------------------
+
+
+def test_chaos_grammar_svc_crash():
+    d = parse_chaos("svc_crash:any@s3")
+    assert len(d) == 1 and d[0].kind == "svc_crash"
+    assert d[0].seg_id == 3 and d[0].param is None
+    with pytest.raises(ValueError, match="takes no param"):
+        parse_chaos("svc_crash:any@s3:2")
+    assert issubclass(ChaosCrash, RuntimeError)
+
+
+def test_svc_crash_kills_worker_and_fires_crash_bundle(
+        src_dir, tmp_path, monkeypatch):
+    monkeypatch.setattr(threading, "excepthook", lambda args: None)
+    d0, _d1, _E = _split_shards(src_dir, tmp_path)
+    dbg = tmp_path / "dbg"
+    with SieveService(
+        _cfg(d0, chaos="svc_crash:any@s1"),
+        _settings(debug_dir=str(dbg)),
+    ) as svc, ServiceClient(svc.addr, timeout_s=2) as cli:
+        # the crashed request never gets a reply: the client times out
+        with pytest.raises((CallTimeout, ConnectionError)):
+            cli.pi(1000)
+        _wait(lambda: svc.recorder.last_bundle is not None,
+              what="crash bundle")
+        b = svc.recorder.last_bundle
+        assert b["trigger"] == "crash"
+        assert "ChaosCrash" in b["detail"]["error"]
+        dirs = list(dbg.glob("bundle-crash-*"))
+        assert len(dirs) == 1
+        with open(dirs[0] / "bundle.json") as f:
+            doc = json.load(f)
+        assert doc["bundle"] == BUNDLE_VERSION and doc["role"] == "service"
+        # one worker died; the survivors still answer exactly (the
+        # timed-out client is desynced by design — use a fresh one)
+        with ServiceClient(svc.addr, timeout_s=10) as cli2:
+            assert cli2.pi(1000) == o_pi(1000)
+            assert cli2.count(100, 5000) == o_count(100, 5000)
+
+
+# --- debug wire op + triggers on server and router --------------------------
+
+
+def test_debug_op_on_server_inline_and_slo_burn_bundle(src_dir, tmp_path):
+    d0, _d1, _E = _split_shards(src_dir, tmp_path)
+    dbg = tmp_path / "dbg"
+    with SieveService(
+        _cfg(d0),
+        _settings(slo_ms={"pi": 0.0001}, slo_window=8,
+                  debug_dir=str(dbg), metrics_sample_s=0.02),
+    ) as svc, ServiceClient(svc.addr, timeout_s=10) as cli:
+        assert cli.pi(1000) == o_pi(1000)  # burns the 0.1us pi SLO
+        _wait(lambda: list(dbg.glob("bundle-slo_burn-*")),
+              what="slo_burn bundle dir")
+        _wait(lambda: svc.history.samples >= 2, what="history samples")
+        b = cli.debug()
+        assert b["bundle"] == BUNDLE_VERSION and b["role"] == "service"
+        assert b["trigger"] == "manual"
+        assert b["recorder"]["bundles"] >= 1
+        assert b["history"], "sampler on: inline bundle carries trend rows"
+        assert any(e.get("event") == "service_slo_burn"
+                   for e in b["events"])
+        assert any(e.get("event") == "service_slo_burn"
+                   for e in b["errors"])  # burn is errorish
+    # recorder off: the op still answers, with a null bundle
+    with SieveService(
+        _cfg(d0), _settings(recorder=False),
+    ) as svc2, ServiceClient(svc2.addr, timeout_s=10) as cli2:
+        assert svc2.recorder is None
+        assert cli2.debug() is None
+        assert cli2.pi(1000) == o_pi(1000)
+
+
+def test_debug_op_on_router_and_shard_down_bundle(src_dir, tmp_path):
+    d0, d1, E = _split_shards(src_dir, tmp_path)
+    dbgr = tmp_path / "dbgr"
+    svcs = [
+        SieveService(_cfg(d0), _settings()).start(),
+        SieveService(_cfg(d1), _settings(range_lo=E)).start(),
+    ]
+    smap = ShardMap([
+        Shard(2, E, (svcs[0].addr,)),
+        Shard(E, N + 1, (svcs[1].addr,)),
+    ])
+    router = SieveRouter(
+        smap, RouterSettings(quiet=True, debug_dir=str(dbgr),
+                             metrics_sample_s=0.0)).start()
+    try:
+        with ServiceClient(router.addr, timeout_s=30) as cli:
+            assert cli.is_prime(101)
+            b = cli.debug()
+            assert b["bundle"] == BUNDLE_VERSION and b["role"] == "router"
+            # shard 0 dark for 0.2s on the next request; the request
+            # itself targets shard 1, so it stays exact
+            router.inject_chaos(f"svc_shard_down:0@s{router._seq + 1}:0.2")
+            lo = E + 10
+            assert cli.count(lo, lo + 100) == o_count(lo, lo + 100)
+            _wait(lambda: list(dbgr.glob("bundle-shard_down-*")),
+                  what="shard_down bundle dir")
+            with open(next(iter(dbgr.glob("bundle-shard_down-*")))
+                      / "bundle.json") as f:
+                doc = json.load(f)
+            assert doc["role"] == "router"
+            assert doc["detail"]["shard"] == 0
+            time.sleep(0.25)  # window over: shard 0 exact again
+            assert cli.pi(1000) == o_pi(1000)
+    finally:
+        router.stop()
+        for s in svcs:
+            s.stop()
+
+
+# --- check_event_schema (satellite, tier-1) ---------------------------------
+
+
+def test_event_schema_check_is_clean_on_this_repo():
+    from tools.check_event_schema import main, missing_kinds
+    assert missing_kinds(str(REPO)) == []
+    assert main([str(REPO)]) == 0
+
+
+def test_event_schema_check_catches_undocumented_kind(tmp_path):
+    from tools.check_event_schema import main, missing_kinds
+    pkg = tmp_path / "sieve"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        'class X:\n'
+        '    def f(self):\n'
+        '        self.metrics.event(\n'
+        '            "bogus_kind_xyz", a=1)\n'
+        '        validate_record({"event": "other_bogus_kind"})\n'
+    )
+    bad = missing_kinds(str(tmp_path))
+    kinds = {k for _, _, k in bad}
+    assert kinds == {"bogus_kind_xyz", "other_bogus_kind"}
+    path, line, _ = bad[0]
+    assert path == os.path.join("sieve", "rogue.py") and line == 3
+    assert main([str(tmp_path)]) == 1
+
+
+# --- fleet_top --json (satellite) -------------------------------------------
+
+
+def _fake_snap(replica_health, shard_status="ok", router_health={"ok": 1}):
+    rep = {"addr": "127.0.0.1:2", "health": replica_health,
+           "stats": {}, "metrics": {}, "error": None}
+    return {
+        "ts": 1.0,
+        "router": {"addr": "127.0.0.1:1", "health": router_health,
+                   "stats": {}, "metrics": {}, "error": None},
+        "shards": [{"shard": 0, "lo": 2, "hi": 100,
+                    "status": shard_status, "replicas": [rep]}],
+    }
+
+
+def test_fleet_top_json_exit_codes(monkeypatch, capsys):
+    import tools.fleet_top as ft
+    snap = _fake_snap({"status": "ok"})
+    monkeypatch.setattr(ft, "fleet_snapshot", lambda a, timeout_s: snap)
+    assert ft.main(["127.0.0.1:1", "--json"]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out)["shards"][0]["status"] == "ok"  # machine-readable
+    # a DOWN replica row flips the exit code
+    snap = _fake_snap(None)
+    assert ft.main(["127.0.0.1:1", "--json"]) == 1
+    # so does a router-side down shard, and an unreachable router
+    snap = _fake_snap({"status": "ok"}, shard_status="down")
+    assert ft.main(["127.0.0.1:1", "--json"]) == 1
+    snap = _fake_snap({"status": "ok"}, router_health=None)
+    assert ft.main(["127.0.0.1:1", "--json"]) == 1
+
+
+# --- trace_report --bundle guards -------------------------------------------
+
+
+def test_trace_report_bundle_named_errors(tmp_path, capsys):
+    from tools.trace_report import main
+    # not a bundle: a plain JSON object without the version key
+    plain = tmp_path / "not_bundle.json"
+    plain.write_text('{"hello": 1}')
+    assert main([str(plain), "--bundle"]) == 1
+    assert "no recognised 'bundle' version key" in capsys.readouterr().err
+    # an empty directory names what it looked for
+    empty = tmp_path / "emptydir"
+    empty.mkdir()
+    assert main([str(empty), "--bundle"]) == 1
+    assert "fleet_bundle.json" in capsys.readouterr().err
+    # truncated JSON exits named, never a traceback
+    trunc = tmp_path / "trunc.json"
+    trunc.write_text('{"bundle": "sieve-debug/1", ')
+    assert main([str(trunc), "--bundle"]) == 1
+    assert "malformed or truncated" in capsys.readouterr().err
+
+
+def test_trace_report_renders_single_bundle(tmp_path, capsys):
+    from tools.trace_report import main
+    rec = FlightRecorder("service", debug_dir=str(tmp_path / "dbg"),
+                         cooldown_s=0.0)
+    rec.emit({"event": "service_shed", "op": "pi"})
+    b = rec.trigger("breaker_open", reason="cold plane errors")
+    assert main([b["path"], "--bundle"]) == 0  # a bundle DIR is accepted
+    out = capsys.readouterr().out
+    assert "debug bundle" in out and "breaker_open" in out
+    assert "service_shed" in out
+
+
+# --- acceptance E2E: subprocess fleet, burn + crash, merged bundle ----------
+
+
+def test_fleet_debug_e2e_burn_crash_merge_and_render(
+        src_dir, tmp_path, capsys):
+    from tools.fleet_debug import collect, main as fleet_debug_main
+    from tools.trace_report import main as trace_report_main
+
+    d0, d1, E = _split_shards(src_dir, tmp_path)
+    dbg = [tmp_path / "dbg0", tmp_path / "dbg1", tmp_path / "dbgr"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO),
+               SIEVE_SVC_SLO_MS_PI="0.0001", SIEVE_SVC_SLO_MS_COUNT="0.0001",
+               SIEVE_METRICS_SAMPLE_S="0.05")
+    procs, addrs = [], []
+    try:
+        for i, (d, extra) in enumerate((
+            (d0, ["--chaos", "svc_crash:any@s1"]),
+            (d1, ["--range-lo", str(E)]),
+        )):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "sieve", "serve",
+                 "--addr", "127.0.0.1:0", "--n", str(N), "--segments", "4",
+                 "--packing", "wheel30", "--checkpoint-dir", d,
+                 "--refresh-s", "0", "--quiet", "--allow-chaos",
+                 "--debug-dir", str(dbg[i]), *extra],
+                env=env, cwd=str(REPO), stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+            procs.append(p)
+            head = json.loads(p.stdout.readline())
+            assert head["event"] == "serving"
+            addrs.append(head["addr"])
+
+        # shard 0's first query trips svc_crash: the worker dies, the
+        # request gets no reply, and the crash bundle freezes
+        with ServiceClient(addrs[0], timeout_s=3) as direct:
+            with pytest.raises((CallTimeout, ConnectionError)):
+                direct.pi(1000)
+
+        rp = subprocess.Popen(
+            [sys.executable, "-m", "sieve", "route",
+             "--addr", "127.0.0.1:0",
+             "--shard", f"2:{E}={addrs[0]}",
+             "--shard", f"{E}:{N + 1}={addrs[1]}",
+             "--quiet", "--allow-chaos", "--debug-dir", str(dbg[2])],
+            env=env, cwd=str(REPO), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        procs.append(rp)
+        rhead = json.loads(rp.stdout.readline())
+        assert rhead["event"] == "routing"
+        raddr = rhead["addr"]
+
+        with ServiceClient(raddr, timeout_s=30) as cli:
+            q = 0
+            # exact answers on both shards; every completed op burns the
+            # absurd 0.1us SLO, freezing slo_burn bundles per replica
+            for i in range(6):
+                x = (97 * (i + 1)) % N
+                assert cli.is_prime(x) == bool(o_count(x, x + 1))
+                q += 1
+            assert cli.pi(N - 1) == o_pi(N - 1)  # 2-shard scatter
+            q += 1
+            # shard 0 dark for 0.2s at request q+1, which targets shard
+            # 1 — exact result, shard_down bundle on the router
+            cli.inject_chaos(f"svc_shard_down:0@s{q + 1}:0.2")
+            lo = E + 10
+            assert cli.count(lo, lo + 100) == o_count(lo, lo + 100)
+            q += 1
+            time.sleep(0.25)
+            assert cli.pi(1000) == o_pi(1000)  # shard 0 back, still exact
+
+        _wait(lambda: list(dbg[0].glob("bundle-crash-*")),
+              what="replica crash bundle")
+        _wait(lambda: list(dbg[0].glob("bundle-slo_burn-*"))
+              and list(dbg[1].glob("bundle-slo_burn-*")),
+              what="slo_burn bundles on both replicas")
+        _wait(lambda: list(dbg[2].glob("bundle-shard_down-*")),
+              what="router shard_down bundle")
+
+        # fleet-wide collection: router + both replicas, ONE document
+        fleet = collect(raddr, timeout_s=10)
+        assert fleet["bundle"] == FLEET_BUNDLE_VERSION
+        assert fleet["processes"] == 3
+        assert fleet["router"]["bundle"]["role"] == "router"
+        assert sorted(r["shard"] for r in fleet["replicas"]) == [0, 1]
+        pids = {fleet["router"]["bundle"]["pid"]} | {
+            r["bundle"]["pid"] for r in fleet["replicas"]
+        }
+        assert len(pids) == 3  # three distinct OS processes merged
+        for rep in fleet["replicas"]:
+            assert rep["bundle"]["role"] == "service"
+            assert rep["bundle"]["recorder"]["bundles"] >= 1
+            assert rep["bundle"]["history"], "sampler env reached subprocs"
+
+        out_dir = tmp_path / "fleet"
+        assert fleet_debug_main(
+            [raddr, "--out", str(out_dir), "--timeout", "10"]) == 0
+        line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert line["event"] == "fleet_bundle"
+        assert line["processes"] == 3 and line["unreachable"] == []
+        bundle_path = out_dir / "fleet_bundle.json"
+        assert bundle_path.is_file() and Path(line["path"]) == bundle_path
+
+        # the postmortem renders without error and names the trauma
+        assert trace_report_main([str(bundle_path), "--bundle"]) == 0
+        rendered = capsys.readouterr().out
+        assert "fleet debug bundle" in rendered
+        assert "3 processes captured" in rendered
+        assert "router" in rendered and "replica" in rendered
+        assert "metrics history" in rendered
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)

@@ -189,6 +189,8 @@ def test_registry_instruments():
     assert snap["ms"] == {
         "type": "histogram", "count": 3, "sum": 6.0,
         "min": 1.0, "max": 3.0, "mean": 2.0,
+        # reservoir percentiles (ISSUE 13): nearest-rank over all 3 obs
+        "p50": 2.0, "p95": 3.0, "p99": 3.0,
     }
     assert reg.counter("done") is c  # same name -> same instrument
     with pytest.raises(TypeError):

@@ -12,13 +12,20 @@ polls; the first frame shows totals only.
 
 Percentiles with zero observations render as ``-`` — never a fake 0.
 
+``--json`` (ISSUE 13) takes one poll and prints the raw snapshot as a
+single JSON document for scripts and cron probes — no table, no screen
+clear — exiting 1 if the router is unreachable or any shard/replica row
+would render DOWN or UNREACHABLE.
+
 Usage:
     python tools/fleet_top.py 127.0.0.1:7733 [--interval 2.0] [--once]
+    python tools/fleet_top.py 127.0.0.1:7733 --json
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -68,6 +75,23 @@ def fleet_snapshot(router_addr: str, timeout_s: float = 5.0) -> dict:
                 ],
             })
     return {"ts": time.time(), "router": router, "shards": shards}
+
+
+def fleet_ok(snap: dict) -> bool:
+    """True when every row of a snapshot would render healthy.
+
+    False if the router itself is unreachable, any shard's router-side
+    status is down/unreachable, or any replica poll came back without a
+    health block (the table's DOWN rows)."""
+    if snap["router"]["health"] is None:
+        return False
+    for sh in snap["shards"]:
+        if str(sh.get("status", "")).lower() in ("down", "unreachable"):
+            return False
+        for rep in sh["replicas"]:
+            if rep["health"] is None:
+                return False
+    return True
 
 
 def _rate(cur: dict | None, prev: dict | None, key: str,
@@ -197,7 +221,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="per-endpoint RPC timeout")
     p.add_argument("--once", action="store_true",
                    help="render one frame and exit (no screen clear)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="one poll, raw snapshot as a single JSON document; "
+                        "exit 1 if any row is DOWN or UNREACHABLE")
     args = p.parse_args(argv)
+    if args.as_json:
+        snap = fleet_snapshot(args.router_addr, timeout_s=args.timeout)
+        print(json.dumps(snap))
+        return 0 if fleet_ok(snap) else 1
     prev: dict | None = None
     try:
         while True:

@@ -18,12 +18,19 @@ trace-context prefix with the shard-replica ``rpc.query`` children
 merged under per-replica tracks, plus each replica's clock-alignment
 error bound.
 
+``--bundle`` renders a flight-recorder postmortem bundle (ISSUE 13, see
+sieve/debug.py) instead of a trace: what tripped the trigger, metric
+sparklines over the bundled history window, the span-ring tail, and the
+last error-ish events — for a single-process ``bundle.json`` or a
+merged ``fleet_bundle.json`` from tools/fleet_debug.py (a directory is
+accepted and searched for either file).
+
 A file that is not valid trace JSON (truncated write, wrong file, a
 bare object without ``traceEvents``) exits 1 with a named
 ``trace_report: error:`` line instead of a traceback.
 
 Usage: python tools/trace_report.py TRACE_FILE [--top N]
-       [--cluster | --routed]
+       [--cluster | --routed | --bundle]
 """
 
 from __future__ import annotations
@@ -713,6 +720,191 @@ def cluster_report(events: list[dict], top: int = 10) -> str:
     return "\n".join(lines)
 
 
+# --- flight-recorder bundles (ISSUE 13) ---------------------------------
+
+_SPARK = "▁▂▃▄▅▆▇█"
+_BUNDLE_PREFIX = "sieve-debug/"
+_FLEET_PREFIX = "sieve-fleet-debug/"
+
+
+def load_bundle(path: str) -> dict:
+    """A flight-recorder bundle document from a file or a bundle dir.
+
+    Accepts a ``bundle.json`` / ``fleet_bundle.json`` path directly, or
+    a directory that contains either. Raises :class:`TraceLoadError`
+    (named, no traceback) on anything that is not a recorder bundle."""
+    if os.path.isdir(path):
+        for name in ("fleet_bundle.json", "bundle.json"):
+            cand = os.path.join(path, name)
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            raise TraceLoadError(
+                f"{path}: directory holds no fleet_bundle.json or "
+                "bundle.json"
+            )
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except json.JSONDecodeError as e:
+        raise TraceLoadError(
+            f"{path}: malformed or truncated bundle JSON ({e})"
+        ) from None
+    except UnicodeDecodeError:
+        raise TraceLoadError(f"{path}: not a text JSON file") from None
+    except OSError as e:
+        raise TraceLoadError(f"{path}: {e.strerror or e}") from None
+    ver = doc.get("bundle") if isinstance(doc, dict) else None
+    if not isinstance(ver, str) or not ver.startswith(
+        (_BUNDLE_PREFIX, _FLEET_PREFIX)
+    ):
+        raise TraceLoadError(
+            f"{path}: no recognised 'bundle' version key — not a "
+            "flight-recorder bundle (see sieve/debug.py)"
+        )
+    return doc
+
+
+def _sparkline(vals: list) -> str:
+    pts = [float(v) for v in vals
+           if isinstance(v, (int, float)) and math.isfinite(v)]
+    if not pts:
+        return "-"
+    lo, hi = min(pts), max(pts)
+    if hi <= lo:
+        return _SPARK[0] * len(pts)
+    scale = (len(_SPARK) - 1) / (hi - lo)
+    return "".join(_SPARK[int((v - lo) * scale + 0.5)] for v in pts)
+
+
+def _history_series(history: list) -> dict[str, list]:
+    """name -> per-sample numeric series across a bundle's history rows.
+
+    Counters/gauges contribute their ``value``; histograms their
+    ``count``. A metric absent from an older row pads with None so every
+    series spans the same sample axis."""
+    names: list[str] = []
+    seen: set[str] = set()
+    for row in history:
+        for name, inst in (row.get("metrics") or {}).items():
+            if name not in seen and isinstance(inst, dict):
+                seen.add(name)
+                names.append(name)
+    series: dict[str, list] = {n: [] for n in names}
+    for row in history:
+        snap = row.get("metrics") or {}
+        for n in names:
+            inst = snap.get(n)
+            if not isinstance(inst, dict):
+                series[n].append(None)
+            elif "value" in inst:
+                series[n].append(inst["value"])
+            else:
+                series[n].append(inst.get("count"))
+    return series
+
+
+def _compact(d: dict, skip: tuple = ("event", "ts")) -> str:
+    parts = [f"{k}={d[k]!r}" for k in d if k not in skip]
+    s = " ".join(parts)
+    return s if len(s) <= 72 else s[:69] + "..."
+
+
+def _one_bundle_lines(b: dict, max_series: int = 12,
+                      span_tail: int = 15, error_tail: int = 10) -> list:
+    lines = [
+        f"  role={b.get('role')} pid={b.get('pid')} "
+        f"wall={b.get('wall_time')}",
+        f"  trigger: {b.get('trigger')}"
+        + (f"  detail: {json.dumps(b.get('detail'))}"
+           if b.get("detail") else ""),
+    ]
+    if b.get("path"):
+        lines.append(f"  written: {b['path']}")
+    rec = b.get("recorder") or {}
+    lines.append(
+        f"  recorder: {rec.get('bundles', 0)} bundles, "
+        f"{rec.get('suppressed', 0)} suppressed by cooldown, "
+        f"{b.get('spans_dropped', 0)} spans dropped by ring"
+    )
+    history = b.get("history") or []
+    series = _history_series(history)
+    if series:
+        lines.append(f"  metrics history ({len(history)} samples):")
+        shown = 0
+        for name, vals in series.items():
+            if shown >= max_series:
+                lines.append(
+                    f"    ... {len(series) - shown} more series"
+                )
+                break
+            last = next((v for v in reversed(vals) if v is not None), None)
+            lines.append(
+                f"    {name:<38} last={last!r:>10}  {_sparkline(vals)}"
+            )
+            shown += 1
+    else:
+        lines.append("  metrics history: no samples (sampler disabled?)")
+    spans = b.get("spans") or []
+    if spans:
+        lines.append(f"  span tail (last {min(span_tail, len(spans))} "
+                     f"of {len(spans)}):")
+        for s in spans[-span_tail:]:
+            dur = s.get("dur")
+            dur_ms = f"{dur / 1e3:.3f} ms" if dur is not None else "-"
+            lines.append(f"    {s.get('name', '?'):<28} {dur_ms:>12}")
+    errors = b.get("errors") or []
+    if errors:
+        lines.append(f"  last errors ({len(errors)}):")
+        for e in errors[-error_tail:]:
+            lines.append(f"    {e.get('event', '?'):<24} {_compact(e)}")
+    else:
+        lines.append("  last errors: none recorded")
+    return lines
+
+
+def bundle_report(doc: dict) -> str:
+    """Terminal postmortem of a flight-recorder bundle (pure function).
+
+    Handles both a single-process bundle and a merged fleet bundle from
+    tools/fleet_debug.py."""
+    ver = doc.get("bundle", "")
+    lines: list[str] = []
+    if ver.startswith(_FLEET_PREFIX):
+        reps = doc.get("replicas") or []
+        lines.append(
+            f"fleet debug bundle ({ver}): "
+            f"{doc.get('processes', 0)} processes captured"
+        )
+        router = doc.get("router") or {}
+        lines.append("")
+        if router.get("bundle"):
+            lines.append(f"router {router.get('addr', '?')}")
+            lines.extend(_one_bundle_lines(router["bundle"]))
+        else:
+            lines.append(
+                f"router {router.get('addr', '?')}: NO BUNDLE "
+                f"({router.get('error')})"
+            )
+        for rep in reps:
+            tag = (f"s{rep['shard']} " if rep.get("shard") is not None
+                   else "")
+            lines.append("")
+            if rep.get("bundle"):
+                lines.append(f"replica {tag}{rep.get('addr', '?')}")
+                lines.extend(_one_bundle_lines(rep["bundle"]))
+            else:
+                lines.append(
+                    f"replica {tag}{rep.get('addr', '?')}: NO BUNDLE "
+                    f"({rep.get('error')})"
+                )
+        return "\n".join(lines)
+    lines.append(f"debug bundle ({ver})")
+    lines.extend(_one_bundle_lines(doc))
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         description="summarize a sieve --trace file (Chrome trace-event "
@@ -729,7 +921,19 @@ def main(argv: list[str] | None = None) -> int:
                    help="fleet view of a merged router trace: rpc.route "
                         "<-> shard rpc.query correlation, per-replica "
                         "tracks, clock-alignment error")
+    p.add_argument("--bundle", action="store_true",
+                   help="render a flight-recorder postmortem bundle "
+                        "(bundle.json, fleet_bundle.json, or a bundle "
+                        "directory) instead of a trace")
     args = p.parse_args(argv)
+    if args.bundle:
+        try:
+            doc = load_bundle(args.trace_file)
+        except TraceLoadError as e:
+            print(f"trace_report: error: {e}", file=sys.stderr)
+            return 1
+        print(bundle_report(doc))
+        return 0
     try:
         events = load_all(args.trace_file)
     except TraceLoadError as e:
