@@ -379,6 +379,115 @@ def service_latency_metric() -> None:
     )
 
 
+def service_hot_qps_metric() -> None:
+    """Wire-plane throughput line (ISSUE 14 tentpole gate): hot-query
+    throughput on ONE replica, three ways over the same 256 hot prefix
+    queries — sequential (one request in flight, the pre-ISSUE-14
+    ceiling), pipelined (submit/drain on one connection), and batched
+    (one ``batch`` RPC per 256 members, answered by a single vectorized
+    ``np.searchsorted`` row). Every answer is asserted exact against a
+    host oracle. ``service_hot_qps`` is the batched number; its
+    ``vs_baseline`` is batched/sequential and the acceptance bar is
+    >=10x at a sequential hot p95 no worse than BENCH_r09's. Gated
+    round-over-round by tools/bench_compare.py's ``qps`` rule."""
+    import tempfile
+
+    import numpy as np
+
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+    from sieve.seed import seed_primes
+    from sieve.service import ServiceClient, ServiceSettings, SieveService
+
+    n = 2_000_000
+    chunk = 1 << 18
+    oracle = seed_primes(n + chunk)
+
+    def o_pi(x: int) -> int:
+        return int(np.searchsorted(oracle, x, side="right"))
+
+    xs = [(7919 * (i + 1)) % n for i in range(256)]
+    want = [o_pi(x) for x in xs]
+
+    with tempfile.TemporaryDirectory(prefix="sieve_bench_qps") as ck:
+        cfg = SieveConfig(
+            n=n, backend="cpu-numpy", packing="odds", n_segments=8,
+            checkpoint_dir=ck, quiet=True,
+        )
+        run_local(cfg)
+        settings = ServiceSettings(
+            # queue sized for the 256-deep pipeline: this line measures
+            # the wire plane, not admission control (ISSUE 10 benches
+            # keep the small-queue shed behavior honest)
+            workers=4, queue_limit=512, cold_chunk=chunk, refresh_s=0.0,
+        )
+        with SieveService(cfg, settings) as svc, \
+                ServiceClient(svc.addr, timeout_s=60) as cli:
+            for x, w in zip(xs[:64], want[:64]):  # warm index/LRU paths
+                assert cli.pi(x) == w, f"warm pi({x}) parity failure"
+
+            # sequential baseline: one request in flight, client-side
+            # per-call latency measured for the hot p95 guard
+            lat_ms: list[float] = []
+            t0 = time.perf_counter()
+            for x, w in zip(xs, want):
+                c0 = time.perf_counter()
+                assert cli.pi(x) == w, f"seq pi({x}) parity failure"
+                lat_ms.append((time.perf_counter() - c0) * 1000.0)
+            seq_s = time.perf_counter() - t0
+            seq_qps = len(xs) / seq_s
+
+            # pipelined: submit all 256 on one connection, then drain
+            reps_p = 8
+            t0 = time.perf_counter()
+            for _ in range(reps_p):
+                ids = [cli.submit("pi", x=x) for x in xs]
+                replies = cli.drain(ids)
+                for rid, w in zip(ids, want):
+                    assert replies[rid].get("ok") and \
+                        replies[rid]["value"] == w, \
+                        f"pipelined pi parity failure: {replies[rid]!r}"
+            pipe_qps = reps_p * len(xs) / (time.perf_counter() - t0)
+
+            # batched: one RPC per 256 members, one vectorized gather
+            items = [{"op": "pi", "x": x} for x in xs]
+            reps_b = 40
+            t0 = time.perf_counter()
+            for _ in range(reps_b):
+                outs = cli.query_batch(items)
+                for o, w in zip(outs, want):
+                    assert o.get("ok") and o["value"] == w, \
+                        f"batch pi parity failure: {o!r}"
+            batch_qps = reps_b * len(xs) / (time.perf_counter() - t0)
+
+    hot_p95 = _pctile(lat_ms, 0.95)
+    print(
+        json.dumps(
+            {
+                "metric": "service_hot_qps",
+                "value": round(batch_qps, 1),
+                "unit": "qps",
+                "vs_baseline": round(batch_qps / seq_qps, 2),
+                "sequential_qps": round(seq_qps, 1),
+                "pipeline_qps": round(pipe_qps, 1),
+                "hot_p95_ms": round(hot_p95, 3),
+                "queries": reps_b * len(xs),
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "service_pipeline_qps",
+                "value": round(pipe_qps, 1),
+                "unit": "qps",
+                "vs_baseline": round(pipe_qps / seq_qps, 2),
+                "queries": reps_p * len(xs),
+            }
+        )
+    )
+
+
 def service_hot_under_flood_metric() -> None:
     """Priority-lane metric (ISSUE 10): hot-query p95 while a 20-thread
     cold flood saturates the backend plane (``cold_delay_s`` simulated).
@@ -846,6 +955,7 @@ def main() -> int:
     host_prepare_metric()
     fused_reduction_metric()
     service_latency_metric()
+    service_hot_qps_metric()
     service_hot_under_flood_metric()
     router_query_latency_metric()
     service_trace_overhead_metric()

@@ -105,6 +105,19 @@ sequence like the other ``svc_*`` request kinds):
                               with a counted ``router_trace_gap`` event,
                               never an error.
 
+Wire plane (ISSUE 14; drawn on the request sequence like the other
+``svc_*`` request kinds):
+
+* ``svc_slow_frame:any@sK:bytes``  from request K on, replies to THAT
+                              connection are dribbled at ``bytes`` per
+                              event-loop tick (default 1.0) — a slow
+                              consumer on the write side. The event
+                              loop must keep every other connection's
+                              replies flowing at full speed (no
+                              head-of-line blocking across sockets),
+                              and the throttled client still gets an
+                              exact answer, just slowly.
+
 Flight recorder (ISSUE 13):
 
 * ``svc_crash:any@sK``        request K's worker thread raises uncaught
@@ -153,6 +166,7 @@ KINDS = (
     "svc_shard_down",
     "svc_trace_drop",
     "svc_crash",
+    "svc_slow_frame",
 )
 # kinds handled by the query service (sieve/service/); the cluster plane
 # ignores these and vice versa. Request-scoped kinds key on the request
@@ -171,6 +185,7 @@ SERVICE_KINDS = (
     "svc_flood",
     "svc_trace_drop",
     "svc_crash",
+    "svc_slow_frame",
 )
 SERVICE_REQUEST_KINDS = (
     "svc_stall",
@@ -181,6 +196,7 @@ SERVICE_REQUEST_KINDS = (
     "svc_flood",
     "svc_trace_drop",
     "svc_crash",
+    "svc_slow_frame",
 )
 # drawn by the router tier (ISSUE 11) on ITS request sequence; the
 # directive's worker field names a shard index there, so shard servers
@@ -210,6 +226,8 @@ DEFAULT_PARAM: dict[str, float | str | None] = {
     "svc_shard_down": 1.0,
     "svc_trace_drop": None,
     "svc_crash": None,
+    # param = reply bytes written per event-loop tick on that connection
+    "svc_slow_frame": 1.0,
 }
 
 

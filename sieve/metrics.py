@@ -131,6 +131,13 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     # breaker_open / shard_down / crash), "path" the bundle directory
     # (None when no --debug-dir is set and the freeze stayed in memory)
     "debug_bundle": {"trigger", "path"},
+    # wire plane (ISSUE 14): service_slow_frame marks a connection put
+    # under the svc_slow_frame chaos throttle (its replies dribble at
+    # "bytes_per_tick" per event-loop tick); service_slow_consumer
+    # marks a connection killed because its bounded write queue
+    # overflowed ("queued_bytes" = bytes pending when the cap tripped).
+    "service_slow_frame": {"bytes_per_tick"},
+    "service_slow_consumer": {"queued_bytes"},
 }
 
 

@@ -13,6 +13,15 @@ or mid-body), letting callers distinguish an orderly close from a
 protocol error; socket timeouts propagate as ``socket.timeout`` so both
 planes can bound every read (a dead peer must never park a thread in
 ``recv`` forever — ISSUE 6/7).
+
+Pipelined framing (ISSUE 14): the frame format is self-delimiting, so
+nothing in it ties one request to one reply — requests carry ``id``,
+replies echo it, and any number may be in flight per connection.
+``encode_msg`` produces one wire frame for queue-based senders, and
+:class:`FrameDecoder` turns an arbitrary byte stream (non-blocking
+reads of any size, including mid-frame) back into messages — the
+service's selector event loop reads through it, while blocking callers
+keep using ``recv_msg`` unchanged.
 """
 
 from __future__ import annotations
@@ -21,10 +30,60 @@ import json
 import socket
 import struct
 
+# Upper bound on a single frame accepted by the incremental decoder: a
+# peer that sends a garbage length prefix must be cut off, not allowed
+# to make the event loop buffer gigabytes waiting for a body that never
+# comes. Generous — a max_primes=200_000 reply is ~2 MB.
+MAX_FRAME = 256 << 20
+
+
+def encode_msg(msg: dict) -> bytes:
+    """One complete wire frame (length prefix + JSON body)."""
+    blob = json.dumps(msg).encode()
+    return struct.pack(">Q", len(blob)) + blob
+
 
 def send_msg(sock: socket.socket, msg: dict) -> None:
-    blob = json.dumps(msg).encode()
-    sock.sendall(struct.pack(">Q", len(blob)) + blob)
+    sock.sendall(encode_msg(msg))
+
+
+class FrameDecoder:
+    """Incremental frame decoder for non-blocking readers.
+
+    Feed it whatever ``recv`` returned — single bytes, half a header,
+    ten frames at once — and it yields every complete message, keeping
+    the undecoded tail buffered. Raises ``ValueError`` on an oversized
+    length prefix or a non-JSON body, which callers treat exactly like
+    a framing error from ``recv_msg``: close the connection.
+    """
+
+    __slots__ = ("_buf", "_max_frame")
+
+    def __init__(self, max_frame: int = MAX_FRAME):
+        self._buf = bytearray()
+        self._max_frame = max_frame
+
+    def feed(self, data: bytes) -> list[dict]:
+        self._buf += data
+        out: list[dict] = []
+        while True:
+            if len(self._buf) < 8:
+                return out
+            (length,) = struct.unpack(">Q", bytes(self._buf[:8]))
+            if length > self._max_frame:
+                raise ValueError(
+                    f"frame of {length} bytes exceeds MAX_FRAME "
+                    f"({self._max_frame})"
+                )
+            if len(self._buf) < 8 + length:
+                return out
+            blob = bytes(self._buf[8:8 + length])
+            del self._buf[:8 + length]
+            out.append(json.loads(blob))
+
+    def buffered(self) -> int:
+        """Bytes waiting for the rest of their frame (slowloris gauge)."""
+        return len(self._buf)
 
 
 def recv_msg(sock: socket.socket) -> dict | None:

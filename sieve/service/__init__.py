@@ -65,10 +65,29 @@ under ``--debug-dir``, one per trigger kind per cooldown; the
 bundle that ``tools/trace_report.py --bundle`` renders. The
 ``svc_crash`` chaos kind kills a worker thread for real to drill the
 crash path.
+
+Multiplexed wire plane (ISSUE 14): the listener is a single-threaded
+``selectors`` event loop — non-blocking reads stream through an
+incremental frame decoder, any number of pipelined requests ride one
+connection (replies correlate by id, in COMPLETION order), and each
+connection owns a bounded write queue with inline ops (health / stats
+/ metrics / debug) front-inserted ahead of queued query replies. The
+``batch`` wire op answers M prefix/interval/is_prime members with one
+vectorized ``np.searchsorted`` row over the index prefix (cold members
+walk the ColdBatcher individually, each with a typed per-member
+outcome); the router scatter-gathers a client batch as at most ONE
+downstream batch RPC per shard. :class:`ServiceClient` grows
+``submit``/``drain``/``query_batch``, :class:`ReplicaSet` grows
+``query_many`` (mid-pipeline failover retries only the unanswered
+suffix) and ``query_batch``, and :class:`ClientPool` gives the fleet
+tools one reused pipelined connection per endpoint. The
+``svc_slow_frame`` chaos kind dribbles one connection's replies
+byte-by-byte to prove no cross-connection head-of-line blocking.
 """
 
 from sieve.service.client import (
     CallTimeout,
+    ClientPool,
     ReplicaSet,
     ServiceClient,
     ServiceError,
@@ -91,6 +110,7 @@ from sieve.service.shards import Shard, ShardMap
 __all__ = [
     "BadRequest",
     "CallTimeout",
+    "ClientPool",
     "ColdBatcher",
     "DeadlineExceeded",
     "Degraded",

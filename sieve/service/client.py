@@ -1,7 +1,16 @@
-"""Blocking client for the query service (one socket, one request at a
-time). Concurrency = one client per thread; the framing and the server's
-per-connection send lock keep each connection's request/reply stream
-ordered, so a synchronous client never sees an interleaved reply.
+"""Blocking client for the query service, with optional pipelining.
+
+``pi``/``count``/... are one-request-at-a-time and unchanged. Since the
+wire plane went event-loop (ISSUE 14) the server answers replies in
+COMPLETION order, not send order — a hot query pipelined behind a cold
+one comes back first — so the client correlates replies to requests by
+the ``id`` each reply echoes, stashing out-of-order arrivals until
+their turn. :meth:`ServiceClient.submit` sends without waiting and
+returns the wire id; :meth:`ServiceClient.drain` collects any set of
+outstanding replies; :meth:`ServiceClient.query_batch` ships M member
+queries in ONE ``batch`` RPC and returns M typed per-member outcomes.
+Concurrency is still one client per thread — pipelining happens within
+a thread, not across threads.
 
 Typed errors surface as :class:`ServiceError` with the server's error
 kind (``overloaded`` / ``deadline_exceeded`` / ``degraded`` /
@@ -86,6 +95,10 @@ class ServiceClient:
         self._run_id = uuid.uuid4().hex[:8]
         self._ctx_seq = itertools.count(1)
         self._dead = False
+        # pipelining (ISSUE 14): ids awaiting a reply (→ send timestamp)
+        # and replies that arrived before their turn (id → reply)
+        self._pending: dict[Any, float] = {}
+        self._replies: dict[Any, dict] = {}
 
     def close(self) -> None:
         self._dead = True
@@ -102,27 +115,48 @@ class ServiceClient:
 
     # --- raw -------------------------------------------------------------
 
-    def _call(self, msg: dict) -> dict:
+    def _send(self, msg: dict):
+        """Ship one message without waiting; returns its wire id."""
         if self._dead:
             raise ConnectionError(
                 "connection closed (earlier timeout desynced the reply "
                 "stream); open a new client"
             )
-        msg.setdefault("id", next(self._ids))
+        rid = msg.setdefault("id", next(self._ids))
         send_msg(self._sock, msg)
-        try:
-            reply = recv_msg(self._sock)
-        except socket.timeout:
-            # the request is still in flight server-side: a later recv on
-            # this socket would read THIS reply as its own — close it
-            self.close()
-            raise CallTimeout(
-                f"no reply within {self._sock.gettimeout()}s; connection "
-                "closed (request outcome unknown)"
-            ) from None
-        if reply is None:
-            raise ConnectionError("service closed the connection")
-        return reply
+        self._pending[rid] = trace.now_s()
+        return rid
+
+    def _recv_for(self, rid) -> dict:
+        """Block until the reply for ``rid`` arrives. Replies come in
+        COMPLETION order; ones for other outstanding ids are stashed
+        and handed out when their id is asked for."""
+        if rid in self._replies:
+            self._pending.pop(rid, None)
+            return self._replies.pop(rid)
+        while True:
+            try:
+                reply = recv_msg(self._sock)
+            except socket.timeout:
+                # requests are still in flight server-side: a later recv
+                # on this socket would read THEIR replies as its own —
+                # close it (every stashed reply already collected stays
+                # valid; everything still pending is lost)
+                self.close()
+                raise CallTimeout(
+                    f"no reply within {self._sock.gettimeout()}s; "
+                    "connection closed (request outcome unknown)"
+                ) from None
+            if reply is None:
+                raise ConnectionError("service closed the connection")
+            got = reply.get("id")
+            if got == rid:
+                self._pending.pop(rid, None)
+                return reply
+            self._replies[got] = reply
+
+    def _call(self, msg: dict) -> dict:
+        return self._recv_for(self._send(msg))
 
     def query(self, op: str, deadline_s: float | None = None,
               **params: Any) -> dict:
@@ -135,6 +169,41 @@ class ServiceClient:
         msg.setdefault("ctx", f"{self._run_id}/{next(self._ctx_seq)}.0")
         msg.setdefault("t_send", round(trace.now_s(), 6))
         return self._call(msg)
+
+    # --- pipelining (ISSUE 14) -------------------------------------------
+
+    def submit(self, op: str, deadline_s: float | None = None,
+               **params: Any):
+        """Send one query WITHOUT waiting for its reply; returns the
+        wire id to pass to :meth:`drain`. Any number may be in flight."""
+        msg: dict[str, Any] = {"type": "query", "op": op, **params}
+        if deadline_s is not None:
+            msg["deadline_s"] = deadline_s
+        msg.setdefault("ctx", f"{self._run_id}/{next(self._ctx_seq)}.0")
+        msg.setdefault("t_send", round(trace.now_s(), 6))
+        return self._send(msg)
+
+    def drain(self, ids: Sequence | None = None) -> dict:
+        """Collect replies for ``ids`` (default: every outstanding
+        submit), keyed by wire id. Blocks until each asked-for reply
+        has arrived; replies for ids NOT asked for stay stashed."""
+        if ids is None:
+            ids = list(self._pending)
+        return {rid: self._recv_for(rid) for rid in ids}
+
+    def pending(self) -> int:
+        """Submitted requests whose replies have not been collected."""
+        return len(self._pending)
+
+    def query_batch(self, items: Sequence[dict],
+                    deadline_s: float | None = None) -> list[dict]:
+        """One ``batch`` RPC carrying M member queries; returns M typed
+        per-member outcomes (``{"ok": True, "value": ...}`` or
+        ``{"ok": False, "error": kind, ...}``), in member order. Raises
+        :class:`ServiceError` only for whole-batch failures (malformed
+        items container, oversized batch)."""
+        return self._value(self.query("batch", deadline_s,
+                                      items=list(items)))
 
     def _value(self, reply: dict):
         if reply.get("ok"):
@@ -195,6 +264,60 @@ class ServiceClient:
         return self._call({"type": "chaos", "spec": spec})
 
 
+class ClientPool:
+    """One pipelined :class:`ServiceClient` per address, reused across
+    calls (ISSUE 14). tools/fleet_top.py and tools/fleet_debug.py poll
+    every endpoint once per refresh cycle; before the pool each poll
+    opened (and tore down) a fresh TCP connection per target. The pool
+    hands back the cached client until a transport failure invalidates
+    it, and counts reconnects so the reuse is provable in tests."""
+
+    def __init__(self, timeout_s: float = 5.0):
+        self.timeout_s = timeout_s
+        self._clients: dict[str, ServiceClient] = {}
+        self._ever: set[str] = set()
+        self._lock = threading.Lock()
+        self.connects = 0
+        self.reconnects = 0
+
+    def get(self, addr: str) -> ServiceClient:
+        """Cached client for ``addr``; (re)connects only when there is
+        none or the cached one is dead. A re-connection to an address
+        seen before counts as a reconnect."""
+        with self._lock:
+            cli = self._clients.get(addr)
+            if cli is not None and not cli._dead:
+                return cli
+            cli = ServiceClient(addr, timeout_s=self.timeout_s)
+            self._clients[addr] = cli
+            self.connects += 1
+            if addr in self._ever:
+                self.reconnects += 1
+            self._ever.add(addr)
+            return cli
+
+    def invalidate(self, addr: str) -> None:
+        """Drop the cached client after a transport failure; the next
+        :meth:`get` reconnects (and counts it)."""
+        with self._lock:
+            cli = self._clients.pop(addr, None)
+        if cli is not None:
+            cli.close()
+
+    def close(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for cli in clients:
+            cli.close()
+
+    def __enter__(self) -> "ClientPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 # --- replica failover --------------------------------------------------------
 
 # typed error kinds that justify trying another replica: the condition is
@@ -205,8 +328,9 @@ FAILOVER_KINDS = frozenset({"overloaded", "degraded", "draining"})
 
 
 class _Replica:
-    """One address + its connection and circuit state. ``lock`` guards the
-    send/recv pair (framing = one request in flight per connection)."""
+    """One address + its connection and circuit state. ``lock`` guards
+    the connection: one THREAD at a time drives it, though that thread
+    may pipeline any number of requests (ISSUE 14)."""
 
     __slots__ = ("addr", "client", "lock", "fails", "open_until", "probed")
 
@@ -420,6 +544,118 @@ class ReplicaSet:
             f"no replica answered after {self.rounds} rounds over "
             f"{len(self._replicas)} replicas (last: {last_err!r})",
         )
+
+    def query_batch(self, items: Sequence[dict],
+                    deadline_s: float | None = None, *,
+                    ctx: str | None = None,
+                    telemetry: bool = False) -> list[dict]:
+        """One ``batch`` RPC with whole-batch failover: the standard
+        :meth:`query` retry policy applies to the RPC itself (a member's
+        typed outcome is the SERVER's answer and is never retried
+        here — per-member semantics live inside the batch reply)."""
+        return self._value(self.query("batch", deadline_s, ctx=ctx,
+                                      telemetry=telemetry,
+                                      items=list(items)))
+
+    def query_many(self, requests: Sequence[dict],
+                   deadline_s: float | None = None, *,
+                   ctx: str | None = None,
+                   window: int | None = None) -> list[dict]:
+        """Pipeline N independent queries with failover; returns one raw
+        reply dict per request, in REQUEST order.
+
+        Every still-unanswered request rides ONE pipelined connection
+        (at most ``window`` in flight when set), drained in send order.
+        A transport failure mid-pipeline marks that replica down and
+        retries ONLY the unanswered suffix on the next candidate —
+        replies already collected are kept, the suffix gets fresh
+        attempt contexts. A typed FAILOVER_KINDS reply retries just
+        that member; other typed replies (bad_request,
+        deadline_exceeded) are final. Members no replica ever answered
+        come back as synthesized ``unavailable`` replies (or their last
+        failover-kind reply), so positions are stable and the set never
+        invents an answer."""
+        n = len(requests)
+        results: list[dict | None] = [None] * n
+        typed: dict[int, dict] = {}
+        if ctx is None:
+            ctx = f"{self._run_id}/{next(self._ctx_seq)}"
+        last_err: Exception | None = None
+        tries = 0
+        for attempt in range(1, self.rounds + 1):
+            for i_rep, rep in enumerate(self._candidates()):
+                todo = [i for i in range(n) if results[i] is None]
+                if not todo:
+                    return results
+                if i_rep > 0:
+                    with self._lock:
+                        self.failovers += 1
+                tries += 1
+                try:
+                    with rep.lock:
+                        client = self._ensure_client(rep)
+                        cap = window if window and window > 0 else len(todo)
+                        inflight: list[tuple[int, Any, float]] = []
+                        qi = 0
+                        while qi < len(todo) or inflight:
+                            while qi < len(todo) and len(inflight) < cap:
+                                i = todo[qi]
+                                qi += 1
+                                msg = dict(requests[i])
+                                msg["type"] = "query"
+                                msg.pop("id", None)  # ids are per-conn
+                                if (deadline_s is not None
+                                        and "deadline_s" not in msg):
+                                    msg["deadline_s"] = deadline_s
+                                msg["ctx"] = f"{ctx}.{tries}:{i}"
+                                t_send = round(trace.now_s(), 6)
+                                msg["t_send"] = t_send
+                                inflight.append(
+                                    (i, client._send(msg), t_send)
+                                )
+                            i, rid, t_send = inflight.pop(0)
+                            reply = client._recv_for(rid)
+                            reply["probe"] = {
+                                "addr": rep.addr,
+                                "t_send": t_send,
+                                "t_done": round(trace.now_s(), 6),
+                            }
+                            if (reply.get("ok")
+                                    or reply.get("error")
+                                    not in FAILOVER_KINDS):
+                                results[i] = reply
+                            else:
+                                typed[i] = reply  # retry on next replica
+                except (ConnectionError, OSError, CallTimeout) as e:
+                    self._mark_down(rep)
+                    last_err = e
+                    continue
+                except ServiceError as e:  # probe said draining
+                    self._mark_down(rep)
+                    for i in todo:
+                        typed.setdefault(i, {
+                            "ok": False, "error": e.kind,
+                            "detail": e.detail,
+                            "op": str(requests[i].get("op", "")),
+                        })
+                    continue
+                self._mark_up(rep)
+            if (attempt < self.rounds
+                    and any(r is None for r in results)):
+                delay = min(self.backoff_cap_s,
+                            self.backoff_base_s * (2 ** (attempt - 1)))
+                time.sleep(delay * (0.5 + random.random()))
+        for i in range(n):
+            if results[i] is None:
+                results[i] = typed.get(i) or {
+                    "ok": False,
+                    "op": str(requests[i].get("op", "")),
+                    "error": "unavailable",
+                    "detail": f"no replica answered after {self.rounds} "
+                              f"rounds over {len(self._replicas)} "
+                              f"replicas (last: {last_err!r})",
+                }
+        return results
 
     def health(self) -> dict:
         """Health of the first reachable replica (no probe gate: a

@@ -141,6 +141,12 @@ class SieveIndex:
         self._prefix = np.cumsum(
             [r.count for r in self.segments], dtype=np.int64
         )
+        # vectorized twins of _his / segment los for count_upto_batch:
+        # one searchsorted row answers M boundaries at once (ISSUE 14)
+        self._his_np = np.asarray(self._his, dtype=np.int64)
+        self._los_np = np.asarray(
+            [r.lo for r in self.segments], dtype=np.int64
+        )
         self.covered_hi = self._his[-1] if self.segments else self.base
         self.total_primes = int(self._prefix[-1]) if self.segments else 0
         self.bounds: list[int] = [r.lo for r in self.segments] + (
@@ -251,6 +257,51 @@ class SieveIndex:
             ctx.answered_hi = max(ctx.answered_hi, min(chi, v))
             ctx.count_so_far = max(ctx.count_so_far, total)
         return total
+
+    def count_upto_batch(self, vs, ctx: QueryCtx) -> np.ndarray:
+        """Prefix counts for MANY boundaries in one vectorized row
+        (ISSUE 14 batch op): ``out[i]`` = primes in [base, vs[i]).
+
+        One ``np.searchsorted`` over the segment boundaries plus one
+        gather over ``_prefix`` answers every segment-boundary hit —
+        the per-value bisect/branch cost of M scalar ``count_upto``
+        calls collapses into two array ops. Values that land strictly
+        inside a segment still need flag popcounts and fall back to the
+        scalar path individually (their LRU chunks stay hot across the
+        batch). Same domain contract as ``count_upto``: every value in
+        [base, covered_hi]."""
+        arr = np.asarray(list(vs), dtype=np.int64)
+        out = np.zeros(arr.size, dtype=np.int64)
+        if arr.size == 0:
+            return out
+        if int(arr.min()) < self.base:
+            raise ValueError(
+                f"count_upto_batch: value below base={self.base}"
+            )
+        if int(arr.max()) > self.covered_hi:
+            raise ValueError(
+                f"count_upto_batch: value beyond covered_hi="
+                f"{self.covered_hi}"
+            )
+        if not self.segments:
+            return out  # empty index: every legal v equals base
+        ctx.index = True
+        nseg = len(self.segments)
+        j = np.searchsorted(self._his_np, arr, side="right")
+        bases = np.where(j > 0, self._prefix[np.maximum(j - 1, 0)], 0)
+        # boundary hit: v == covered_hi (j == nseg) or v == segments[j].lo
+        lo_j = np.where(j >= nseg, np.int64(self.covered_hi),
+                        self._los_np[np.minimum(j, nseg - 1)])
+        boundary = (j >= nseg) | (arr == lo_j)
+        out[boundary] = bases[boundary]
+        hi_seen = int(arr[boundary].max()) if bool(boundary.any()) else 0
+        ctx.answered_hi = max(ctx.answered_hi, hi_seen, self.base)
+        if bool(boundary.any()):
+            ctx.count_so_far = max(ctx.count_so_far,
+                                   int(out[boundary].max()))
+        for i in np.nonzero(~boundary)[0]:
+            out[i] = self.count_upto(int(arr[i]), ctx)
+        return out
 
     # --- selection -------------------------------------------------------
 

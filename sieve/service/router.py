@@ -91,6 +91,13 @@ _RELAY_KINDS = frozenset({
     "bad_request", "internal", "timeout",
 })
 
+# server wire message types the router deliberately does NOT route
+# (tools/check_wire_ops.py audits this list against both dispatchers):
+# "telemetry" is a per-replica span-ring flush — the router pulls it
+# from each replica itself via ReplicaSet.telemetry_flush, so a client
+# sending it to the router gets the standard typed unknown-type reply.
+UNROUTED_TYPES = ("telemetry",)
+
 
 class ShardUnavailable(Exception):
     """A shard's whole replica set is unreachable (or chaos-held down)."""
@@ -197,6 +204,11 @@ _ROUTER_STATS = (
     "telemetry_merged",
     "telemetry_events",
     "telemetry_gaps",
+    # batch plane (ISSUE 14): batch_rpcs counts DOWNSTREAM batch RPCs —
+    # the ≤1-per-shard-per-client-batch scatter contract is gated on it
+    "batch_requests",
+    "batch_members",
+    "batch_rpcs",
 )
 
 # synthetic pid base for per-shard-replica tracks in the merged trace
@@ -630,10 +642,230 @@ class SieveRouter:
                 raise BadRequest(f"primes: hi={hi} < lo={lo}")
             self._bump("scattered")
             return self._primes(lo, hi, deadline, rctx)
+        if op == "batch":
+            return self._execute_batch(msg, deadline, rctx)
         raise BadRequest(
             f"unknown op {op!r} (one of pi, is_prime, count, nth_prime, "
-            "primes)"
+            "primes, batch)"
         )
+
+    def _shard_batch(self, i: int, items: list[dict], deadline: float,
+                     rctx: _RouteCtx) -> list[dict]:
+        """ONE downstream ``batch`` RPC to shard i (the scatter
+        contract gated by the ``batch_rpcs`` counter). Same budgeting,
+        chaos gate, telemetry absorption, and typed relay as
+        :meth:`_shard_query`."""
+        self._check_shard_up(i)
+        remaining = deadline - trace.now_s()
+        if remaining <= 0:
+            raise DeadlineExceeded(rctx.answered_hi, rctx.count_so_far)
+        rctx.shards.add(i)
+        rctx.calls += 1
+        child_ctx = f"{rctx.ctx}/s{i}.{rctx.calls}"
+        sh = self.map.shards[i]
+        self._bump("batch_rpcs")
+        t0 = trace.now_s()
+        outcome = "ok"
+        try:
+            try:
+                reply = self.sets[i].query("batch", deadline_s=remaining,
+                                           ctx=child_ctx,
+                                           telemetry=trace.enabled(),
+                                           items=items)
+            except (ServiceError, CallTimeout) as e:
+                outcome = "unavailable"
+                raise ShardUnavailable(i, sh.lo, sh.hi, str(e)) from None
+            self._absorb_reply(i, reply)
+            if reply.get("ok"):
+                return reply["value"]
+            outcome = str(reply.get("error", "internal"))
+            raise _Relay(reply, i)
+        finally:
+            trace.add_span("route.scatter", t0, trace.now_s() - t0,
+                           shard=i, op="batch", outcome=outcome,
+                           ctx=child_ctx)
+
+    def _execute_batch(self, msg: dict, deadline: float,
+                       rctx: _RouteCtx) -> list[dict]:
+        """Routed ``batch`` (ISSUE 14): M member queries fan out as at
+        most ONE downstream batch RPC per shard.
+
+        Each member decomposes exactly like its scalar op — is_prime
+        routes point to its owning shard; pi/count(kind=primes) split
+        into per-shard count sub-queries, with fully-covered shards
+        served from the immutable totals cache (a miss rides the same
+        batch RPC and fills the cache). Sub-queries are deduped per
+        shard, so a batch of M members never costs a shard more than
+        its distinct sub-query set in one RPC. A shard that fails
+        (unavailable / typed relay / spent deadline) fails ONLY the
+        members with a term on it — each gets a typed outcome tagged
+        with the shard — while members on healthy shards still answer
+        exactly."""
+        items = msg.get("items")
+        if not isinstance(items, list) or not items:
+            raise BadRequest("batch: items must be a non-empty list")
+        self._bump("batch_requests")
+        self._bump("batch_members", len(items))
+        self._bump("scattered")
+        per_shard: dict[int, dict[tuple, dict]] = {}
+
+        def term(i: int, key: tuple, sub: dict) -> tuple[int, tuple]:
+            per_shard.setdefault(i, {}).setdefault(key, sub)
+            return (i, key)
+
+        # plan each member:
+        #   ("err", outcome) | ("const", op, value)
+        #   | ("point", op, term) | ("sum", op, const, [terms])
+        plans: list[tuple] = []
+        for m in items:
+            mop = str(m.get("op", "")) if isinstance(m, dict) else ""
+            try:
+                if not isinstance(m, dict):
+                    raise BadRequest("batch member must be an object")
+                if mop == "is_prime":
+                    x = _req_int(m, "x")
+                    if x + 1 > MAX_HI:
+                        raise BadRequest(
+                            f"is_prime({x}): x must be < {MAX_HI}"
+                        )
+                    if x < 2:
+                        plans.append(("const", mop, False))
+                        continue
+                    i = self.map.shard_for(x)
+                    plans.append(("point", mop,
+                                  term(i, ("is_prime", x),
+                                       {"op": "is_prime", "x": x})))
+                elif mop in ("pi", "count"):
+                    if mop == "pi":
+                        x = _req_int(m, "x")
+                        if x < 0 or x + 1 > MAX_HI:
+                            raise BadRequest(
+                                f"pi({x}): x must be in [0, {MAX_HI})"
+                            )
+                        lo, hi = 2, x + 1
+                    else:
+                        lo, hi = _req_int(m, "lo"), _req_int(m, "hi")
+                        if hi > MAX_HI:
+                            raise BadRequest(
+                                f"count: hi={hi} exceeds {MAX_HI}"
+                            )
+                        if hi < lo:
+                            raise BadRequest(f"count: hi={hi} < lo={lo}")
+                        if str(m.get("kind", "primes")) != "primes":
+                            raise BadRequest(
+                                "batch count members support "
+                                "kind=primes only"
+                            )
+                        lo = max(lo, 2)
+                    if hi <= lo:
+                        plans.append(("const", mop, 0))
+                        continue
+                    if lo < self.map.lo:
+                        raise BadRequest(
+                            f"{mop}: lo={lo} below the fabric range "
+                            f"[{self.map.lo}, ...)"
+                        )
+                    const = 0
+                    terms: list[tuple[int, tuple]] = []
+                    for i, a, b in self.map.shards_in(lo, hi):
+                        sh = self.map.shards[i]
+                        if (a, b) == (sh.lo, sh.hi):
+                            with self._totals_lock:
+                                cached = self._totals.get(i)
+                            if cached is not None:
+                                registry().counter("router.totals_hit").inc()
+                                const += cached
+                                continue
+                            registry().counter("router.totals_miss").inc()
+                        terms.append(term(i, ("count", a, b),
+                                          {"op": "count", "lo": a, "hi": b}))
+                    plans.append(("sum", mop, const, terms))
+                else:
+                    raise BadRequest(
+                        f"unknown batch member op {mop!r} "
+                        "(one of pi, is_prime, count)"
+                    )
+            except BadRequest as e:
+                plans.append(("err", {
+                    "ok": False, "op": mop, "error": "bad_request",
+                    "detail": str(e), "partial": None,
+                }))
+        # scatter: ONE deduped batch RPC per touched shard, ascending
+        resolved: dict[tuple[int, tuple], dict] = {}
+        for i in sorted(per_shard):
+            keys = sorted(per_shard[i])
+            subs = [per_shard[i][k] for k in keys]
+            fault: dict | None = None
+            try:
+                outs = self._shard_batch(i, subs, deadline, rctx)
+                if not isinstance(outs, list) or len(outs) != len(subs):
+                    got = (len(outs) if isinstance(outs, list)
+                           else type(outs).__name__)
+                    sh = self.map.shards[i]
+                    raise ShardUnavailable(
+                        i, sh.lo, sh.hi,
+                        f"batch reply shape: {got} outcomes for "
+                        f"{len(subs)} members",
+                    )
+            except ShardUnavailable as e:
+                self._bump("shard_errors")
+                fault = {"error": "unavailable", "detail": str(e),
+                         "partial": None}
+            except DeadlineExceeded as e:
+                fault = {"error": "deadline_exceeded", "detail": str(e),
+                         "partial": {"answered_hi": e.answered_hi,
+                                     "count_so_far": e.count_so_far}}
+            except _Relay as e:
+                self._bump("shard_errors")
+                fault = {"error": str(e.reply.get("error", "internal")),
+                         "detail": e.reply.get("detail", ""),
+                         "partial": e.reply.get("partial")}
+            if fault is not None:
+                for k in keys:
+                    resolved[(i, k)] = {"ok": False, "shard": i, **fault}
+                continue
+            for k, o in zip(keys, outs):
+                if not isinstance(o, dict):
+                    o = {"ok": False, "error": "internal",
+                         "detail": "malformed batch member outcome"}
+                if not o.get("ok"):
+                    o.setdefault("shard", i)
+                elif k[0] == "count":
+                    # a full-shard count rode along: fill the totals
+                    # cache (immutable math fact, cached forever)
+                    sh = self.map.shards[i]
+                    if (k[1], k[2]) == (sh.lo, sh.hi):
+                        with self._totals_lock:
+                            self._totals.setdefault(i, int(o["value"]))
+                resolved[(i, k)] = o
+        # assemble per-member outcomes, in member order
+        out: list[dict] = []
+        for plan in plans:
+            kind = plan[0]
+            if kind == "err":
+                out.append(plan[1])
+            elif kind == "const":
+                out.append({"ok": True, "op": plan[1], "value": plan[2]})
+            elif kind == "point":
+                o = dict(resolved[plan[2]])
+                o["op"] = plan[1]
+                if o.get("ok"):
+                    o["value"] = bool(o["value"])
+                out.append(o)
+            else:  # sum
+                _, mop, const, terms = plan
+                bad = next((resolved[t] for t in terms
+                            if not resolved[t].get("ok")), None)
+                if bad is not None:
+                    o = dict(bad)
+                    o["op"] = mop
+                    out.append(o)
+                else:
+                    out.append({"ok": True, "op": mop,
+                                "value": const + sum(
+                                    int(resolved[t]["value"])
+                                    for t in terms)})
+        return out
 
     @staticmethod
     def _partial(op: str, rctx: _RouteCtx) -> dict:

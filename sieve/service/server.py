@@ -66,8 +66,26 @@ Wire protocol (sieve/rpc.py framing; one JSON object per message):
     {"type": "reply", "id": i, "ok": false, "error": "deadline_exceeded",
      "detail": "...", "partial": {"answered_hi": ..., "pi_so_far": ...}}
 
-``health`` / ``stats`` / ``chaos`` messages are answered inline by the
-connection reader — health stays observable even when the queue is full.
+Multiplexed wire plane (ISSUE 14): the listener is a single-threaded
+``selectors`` event loop, not a thread-per-connection reader. Reads are
+non-blocking and stream through an incremental
+:class:`~sieve.rpc.FrameDecoder`, so a client may pipeline any number
+of requests on one connection; replies correlate by ``id`` and come
+back in COMPLETION order, not submission order. Each connection owns a
+bounded write queue (``SIEVE_SVC_WRITE_QUEUE`` bytes; overflow closes
+the connection as a slow consumer with a ``service_slow_consumer``
+event) and ``health`` / ``stats`` / ``metrics`` / ``debug`` / ``chaos``
+replies are front-inserted ahead of queued query replies — health stays
+observable even when the worker pool is wedged. One dribbling
+connection (the ``svc_slow_frame`` chaos kind throttles its write-side
+to N bytes per tick) cannot head-of-line block any other connection.
+
+The ``batch`` query op carries M members
+(``{"op": "pi"|"is_prime"|"count", ...}``) in one frame; every hot
+member resolves through ONE vectorized searchsorted row
+(:meth:`SieveIndex.count_upto_batch`), cold members walk the
+ColdBatcher individually, and each member gets its own typed outcome —
+one member's shed/deadline never poisons its neighbors.
 """
 
 from __future__ import annotations
@@ -77,6 +95,7 @@ import dataclasses
 import math
 import os
 import queue
+import selectors
 import socket
 import threading
 import time
@@ -101,7 +120,7 @@ from sieve.checkpoint import (
 )
 from sieve.enumerate import MAX_HI, primes_in_range
 from sieve.metrics import MetricsHistory, MetricsLogger, registry, sample_interval_s
-from sieve.rpc import parse_addr, recv_msg, send_msg
+from sieve.rpc import FrameDecoder, encode_msg, parse_addr
 from sieve.seed import seed_primes
 from sieve.service.index import QueryCtx, SieveIndex
 
@@ -279,6 +298,13 @@ class ServiceSettings:
     debug_dir: str | None = None
     debug_cooldown_s: float = 30.0
     metrics_sample_s: float = 1.0
+    # wire plane (ISSUE 14): cap on members per ``batch`` wire op (one
+    # RPC carrying M point queries), and the per-connection write-queue
+    # ceiling — a consumer that stops reading its replies is closed as
+    # a slow consumer once this many encoded bytes are parked, so one
+    # stuck socket can never balloon the event loop's memory.
+    batch_queries: int = 1024
+    write_queue_bytes: int = 8 << 20
 
     def validate(self) -> "ServiceSettings":
         """Typed startup validation: every rejection names the setting
@@ -287,7 +313,8 @@ class ServiceSettings:
         behavior in the admission plane."""
         for name in ("queue_limit", "workers", "batch_max_chunks",
                      "lru_segments", "cold_chunk", "cold_cache_entries",
-                     "max_primes", "max_pair_span", "breaker_fails"):
+                     "max_primes", "max_pair_span", "breaker_fails",
+                     "batch_queries", "write_queue_bytes"):
             v = getattr(self, name)
             if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
                 raise ValueError(
@@ -424,6 +451,12 @@ class ServiceSettings:
                 "SIEVE_SVC_DEBUG_COOLDOWN_S", cls.debug_cooldown_s
             ),
             metrics_sample_s=sample_interval_s(),
+            batch_queries=_env_int(
+                "SIEVE_SVC_BATCH_QUERIES", cls.batch_queries
+            ),
+            write_queue_bytes=_env_int(
+                "SIEVE_SVC_WRITE_QUEUE", cls.write_queue_bytes
+            ),
         )
         return dataclasses.replace(s, **overrides)
 
@@ -855,7 +888,64 @@ _STATS = (
     "internal_errors",
     "telemetry_replies",
     "trace_drops",
+    "batch_requests",
+    "batch_members",
+    "slow_consumer_closed",
 )
+
+
+# --- wire event loop (ISSUE 14) ----------------------------------------------
+
+# event-loop tick for throttled (svc_slow_frame) connections: a dribbled
+# write queue drains in bytes-per-tick slices at this cadence while every
+# other connection keeps full-speed service
+_TICK_S = 0.005
+
+
+class _Conn:
+    """Per-connection state owned by the wire event loop.
+
+    The loop thread does all reads; reply frames are appended under
+    ``lock`` and either flushed directly by the replying thread (idle
+    queue, ``tx`` serializes the socket) or left for the woken loop.
+    ``head_off`` tracks how much of the queue's head frame has hit the
+    socket, so a front-inserted inline reply (health/stats/metrics/
+    debug) can jump the queue without ever interleaving into a
+    partially-sent frame.
+    """
+
+    __slots__ = ("sock", "decoder", "wq", "head_off", "wq_bytes", "lock",
+                 "tx", "sending", "closed", "kill", "throttle_bps",
+                 "next_t", "mask")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.decoder = FrameDecoder()
+        self.wq: collections.deque[bytes] = collections.deque()
+        self.head_off = 0
+        self.wq_bytes = 0
+        self.lock = threading.Lock()
+        # serializes actual socket sends: the loop's flush, throttled
+        # ticks, and a worker's opportunistic direct send never
+        # interleave bytes on the wire
+        self.tx = threading.Lock()
+        # True while a send of the head frame is in flight — head_off
+        # only records progress AFTER send() returns, so a front-insert
+        # must also treat an invisible whole-frame send as "the head is
+        # spoken for" or the sender's popleft destroys the wrong frame
+        self.sending = False
+        self.closed = False
+        # set by writers that cannot touch the selector (slow-consumer
+        # overflow): the loop reaps killed conns on its next wakeup
+        self.kill = False
+        # svc_slow_frame chaos: reply bytes per _TICK_S (0 = full speed)
+        self.throttle_bps = 0.0
+        self.next_t = 0.0
+        self.mask = 0  # selector interest currently registered
+
+    def pending(self) -> bool:
+        with self.lock:
+            return bool(self.wq)
 
 
 class SieveService:
@@ -929,11 +1019,15 @@ class SieveService:
         self._stats = {k: 0 for k in _STATS}
         self._stats_lock = threading.Lock()
         self._threads: list[threading.Thread] = []
-        self._conns: set[socket.socket] = set()
+        self._conns: set[_Conn] = set()
         self._conns_lock = threading.Lock()
         self._listener: socket.socket | None = None
         self._bound_addr: str | None = None
         self._closing = False
+        # wire event loop (ISSUE 14): self-wake pipe so worker threads
+        # (and drain/stop) can nudge the selector out of its wait
+        self._wake_r: socket.socket | None = None
+        self._wake_w: socket.socket | None = None
         # graceful drain (ISSUE 8): _inflight_n counts admitted-but-not-
         # replied queries; drain_event fires when draining starts, and
         # _drained once the last in-flight reply is out
@@ -1004,8 +1098,11 @@ class SieveService:
         self._listener.listen(64)
         bhost, bport = self._listener.getsockname()[:2]
         self._bound_addr = f"{bhost}:{bport}"
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="svc-accept")
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        t = threading.Thread(target=self._wire_loop, daemon=True,
+                             name="svc-wire")
         t.start()
         self._threads.append(t)
         for i in range(self.settings.workers):
@@ -1046,18 +1143,16 @@ class SieveService:
             return
         self._draining = True
         if self._listener is not None:
-            # shutdown before close: close() alone leaves the socket alive
-            # while the accept thread is blocked in accept() (it holds a
-            # kernel reference), letting one more connection slip in;
-            # shutdown() wakes the accept and refuses connects immediately
+            # shutdown only — connects are refused immediately, but the
+            # fd stays open until the event loop unregisters it from the
+            # selector (closing here would free the fd while its selector
+            # registration is live; an accepted connection reusing the
+            # number would then collide on register)
             try:
                 self._listener.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
-            try:
-                self._listener.close()
-            except OSError:
-                pass
+        self._wake()
         hot, cold = self._lane_depths()
         self.metrics.event("service_drain", queued=hot + cold,
                            inflight=self._inflight_n)
@@ -1081,28 +1176,25 @@ class SieveService:
         if self.follower is not None:
             self.follower.stop()
         if self._listener is not None:
-            # shutdown() before close(): a plain close does not wake a
-            # thread blocked in accept(), which would stall the join below
+            # shutdown only; the event loop owns the close (see drain)
             try:
                 self._listener.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
-            try:
-                self._listener.close()
-            except OSError:
-                pass
+        self._wake()
         with self._lane_cond:
             self._stopping = True
             self._lane_cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        # the loop's exit path closes every conn; cover a wedged loop too
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
             try:
-                c.close()
+                c.sock.close()
             except OSError:
                 pass
-        for t in self._threads:
-            t.join(timeout=5)
         self.batcher.stop()
         self.cold.close()
         if self.recorder is not None:
@@ -1313,61 +1405,296 @@ class SieveService:
         self.chaos.extend(ds)
         return len(ds)
 
-    # --- network plumbing ------------------------------------------------
+    # --- wire event loop (ISSUE 14) --------------------------------------
 
-    def _accept_loop(self) -> None:
-        assert self._listener is not None
-        while not self._closing:
-            try:
-                conn, _ = self._listener.accept()
-            except OSError:
-                return
-            with self._conns_lock:
-                self._conns.add(conn)
-            t = threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
-            )
-            t.start()
+    def _wake(self) -> None:
+        """Nudge the selector out of its wait (worker reply enqueued, a
+        kill flagged, drain/stop). Safe from any thread."""
+        w = self._wake_w
+        if w is None:
+            return
+        try:
+            w.send(b"\0")
+        except (BlockingIOError, OSError):
+            pass  # pipe full or closed: the loop is waking anyway
 
-    def _serve_conn(self, conn: socket.socket) -> None:
-        send_lock = threading.Lock()
+    def _wire_loop(self) -> None:
+        """The selector event loop: one thread owns every socket.
+
+        Non-blocking reads stream through each connection's incremental
+        :class:`FrameDecoder`, so any number of pipelined requests ride
+        one socket and a peer dribbling a frame byte-by-byte costs one
+        buffer append per tick, never a parked thread. Inline ops are
+        answered right here (front-inserted into the write queue, ahead
+        of any queued query replies); admitted queries flow through the
+        unchanged lane/worker plane, whose replies come back via
+        :meth:`_reply` + the wake pipe. Writes are flushed on
+        write-readiness per connection — svc_slow_frame connections
+        instead drain bytes-per-tick on a timer — so one slow consumer
+        never head-of-line-blocks another connection's replies."""
+        sel = selectors.DefaultSelector()
+        listener = self._listener
+        assert listener is not None and self._wake_r is not None
+        listener.setblocking(False)
+        sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        sel.register(listener, selectors.EVENT_READ, "accept")
+        listener_live = True
         try:
             while not self._closing:
-                try:
-                    msg = recv_msg(conn)
-                except (OSError, ValueError):
-                    return
-                if msg is None:
-                    return
-                if trace.now_s() < self._replica_down_until:
-                    return  # replica_down chaos: drop, no reply
-                if self._dispatch(conn, send_lock, msg) == "drop":
-                    return
+                if listener_live and self._draining:
+                    listener_live = False
+                    try:
+                        sel.unregister(listener)
+                        listener.close()
+                    except (KeyError, ValueError, OSError):
+                        pass
+                timeout = self._refresh_interest(sel)
+                for key, ev in sel.select(timeout):
+                    if key.data == "wake":
+                        try:
+                            while self._wake_r.recv(4096):
+                                pass
+                        except (BlockingIOError, OSError):
+                            pass
+                    elif key.data == "accept":
+                        listener_live = self._accept_ready(sel, listener)
+                    else:
+                        c = key.data
+                        if ev & selectors.EVENT_READ and not c.closed:
+                            self._read_ready(sel, c)
+                        if ev & selectors.EVENT_WRITE and not c.closed:
+                            if not self._flush(c):
+                                self._close_conn(sel, c)
+                self._tick_throttled(sel)
         finally:
             with self._conns_lock:
-                self._conns.discard(conn)
+                conns = list(self._conns)
+            for c in conns:
+                self._close_conn(sel, c)
+            if listener_live:
+                try:
+                    listener.close()
+                except OSError:
+                    pass
+            for s in (self._wake_r, self._wake_w):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            sel.close()
+
+    def _refresh_interest(self, sel) -> float:
+        """Reap killed conns, sync each conn's selector mask with its
+        queue state, and pick the select timeout (a short tick while a
+        throttled connection still has bytes to dribble)."""
+        timeout = 0.2
+        now = time.monotonic()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            if c.kill or c.closed:
+                self._close_conn(sel, c)
+                continue
+            throttled = c.throttle_bps > 0
+            with c.lock:
+                pending = bool(c.wq)
+            desired = selectors.EVENT_READ
+            if pending and not throttled:
+                desired |= selectors.EVENT_WRITE
+            if desired != c.mask:
+                try:
+                    sel.modify(c.sock, desired, c)
+                    c.mask = desired
+                except (KeyError, ValueError, OSError):
+                    self._close_conn(sel, c)
+                    continue
+            if throttled and pending:
+                timeout = min(timeout, max(0.0, c.next_t - now))
+        return timeout
+
+    def _accept_ready(self, sel, listener) -> bool:
+        """Drain the accept backlog; False retires the listener."""
+        while True:
             try:
-                conn.close()
+                sock, _ = listener.accept()
+            except BlockingIOError:
+                return True
             except OSError:
-                pass
+                # drain()/stop() shut the listener down
+                try:
+                    sel.unregister(listener)
+                    listener.close()
+                except (KeyError, ValueError, OSError):
+                    pass
+                return False
+            sock.setblocking(False)
+            c = _Conn(sock)
+            with self._conns_lock:
+                self._conns.add(c)
+            try:
+                sel.register(sock, selectors.EVENT_READ, c)
+                c.mask = selectors.EVENT_READ
+            except (ValueError, OSError):
+                self._close_conn(sel, c)
 
-    def _reply(self, conn: socket.socket, send_lock: threading.Lock,
-               payload: dict) -> None:
+    def _read_ready(self, sel, c: _Conn) -> None:
         try:
-            with send_lock:
-                send_msg(conn, payload)
+            data = c.sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
         except OSError:
-            pass  # client went away; its outcome is already counted
+            self._close_conn(sel, c)
+            return
+        if not data:
+            self._close_conn(sel, c)
+            return
+        try:
+            msgs = c.decoder.feed(data)
+        except ValueError:
+            self._close_conn(sel, c)  # framing garbage: cut the peer off
+            return
+        for msg in msgs:
+            if trace.now_s() < self._replica_down_until:
+                self._close_conn(sel, c)  # replica_down: drop, no reply
+                return
+            if self._dispatch(c, msg) == "drop":
+                self._close_conn(sel, c)
+                return
 
-    def _dispatch(self, conn, send_lock, msg: dict) -> str | None:
+    def _flush(self, c: _Conn, budget: int | None = None) -> bool:
+        """Write queued frames to the socket until it would block, the
+        queue empties, or the byte budget (throttled conns) runs out.
+        False means the peer is gone and the conn must be closed.
+        ``tx`` is held across the whole drain so the loop thread and a
+        worker's direct send can never interleave bytes on the wire."""
+        with c.tx:
+            try:
+                while True:
+                    with c.lock:
+                        if c.closed:
+                            return False
+                        if not c.wq:
+                            return True
+                        head = c.wq[0]
+                        off = c.head_off
+                        c.sending = True
+                    chunk = head[off:]
+                    if budget is not None:
+                        if budget <= 0:
+                            return True
+                        chunk = chunk[:budget]
+                    try:
+                        n = c.sock.send(chunk)
+                    except (BlockingIOError, InterruptedError):
+                        return True
+                    except OSError:
+                        return False
+                    if budget is not None:
+                        budget -= n
+                    with c.lock:
+                        if c.closed:
+                            return False
+                        c.head_off += n
+                        c.wq_bytes -= n
+                        if c.head_off >= len(head):
+                            c.wq.popleft()
+                            c.head_off = 0
+            finally:
+                with c.lock:
+                    c.sending = False
+
+    def _tick_throttled(self, sel) -> None:
+        """svc_slow_frame drain: each throttled connection gets at most
+        ``throttle_bps`` bytes per ``_TICK_S``, on its own clock."""
+        now = time.monotonic()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            if c.closed or c.throttle_bps <= 0 or now < c.next_t:
+                continue
+            if not c.pending():
+                continue
+            c.next_t = now + _TICK_S
+            if not self._flush(c, budget=max(1, int(c.throttle_bps))):
+                self._close_conn(sel, c)
+
+    def _close_conn(self, sel, c: _Conn) -> None:
+        with self._conns_lock:
+            self._conns.discard(c)
+        with c.lock:
+            c.closed = True
+            c.wq.clear()
+            c.wq_bytes = 0
+            c.head_off = 0
+        try:
+            sel.unregister(c.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            c.sock.close()
+        except OSError:
+            pass
+
+    def _reply(self, c: _Conn, payload: dict, front: bool = False) -> None:
+        """Enqueue one encoded reply frame on the connection's bounded
+        write queue and wake the loop. ``front=True`` (inline ops) jumps
+        ahead of queued query replies — but never into the middle of a
+        partially-sent frame. Called from worker threads and from the
+        loop itself; a closed conn swallows the reply (the outcome is
+        already counted), and overflowing the queue kills the slow
+        consumer rather than growing without bound.
+
+        When the queue held nothing before this frame and the conn is
+        unthrottled, the calling thread flushes the frame to the socket
+        directly instead of waking the loop — on a busy box the
+        wake-byte + selector-thread context switch costs more than the
+        reply itself, and an idle-queue conn has no in-flight partial
+        frame a direct send could interleave with (``tx`` guarantees
+        it even against a racing loop flush)."""
+        frame = encode_msg(payload)
+        overflow = False
+        direct = False
+        with c.lock:
+            if c.closed or c.kill:
+                return
+            if c.wq_bytes + len(frame) > self.settings.write_queue_bytes:
+                c.kill = True
+                overflow = True
+            else:
+                if front:
+                    busy_head = (c.head_off > 0 or c.sending) and c.wq
+                    c.wq.insert(1 if busy_head else 0, frame)
+                else:
+                    c.wq.append(frame)
+                c.wq_bytes += len(frame)
+                direct = (len(c.wq) == 1 and c.head_off == 0
+                          and c.throttle_bps <= 0)
+        if overflow:
+            self._bump("slow_consumer_closed")
+            self.metrics.event("service_slow_consumer", quietable=True,
+                               queued_bytes=c.wq_bytes,
+                               limit=self.settings.write_queue_bytes)
+            self._wake()
+            return
+        if direct:
+            if not self._flush(c):
+                with c.lock:
+                    c.kill = True  # peer gone; the loop reaps it
+            elif not c.pending():
+                return  # fully on the wire: the loop has nothing to do
+        self._wake()
+
+    def _dispatch(self, conn: _Conn, msg: dict) -> str | None:
         mtype = msg.get("type")
         rid = msg.get("id")
         idx = self.index  # one snapshot per message, even for health
         if mtype == "health":
-            # answered inline by the reader: health must stay observable
-            # under full-queue shed pressure and a dead backend alike
+            # answered inline by the event loop, front-inserted AHEAD of
+            # queued query replies: health must stay observable under
+            # full-queue shed pressure and a dead backend alike
             hot, cold = self._lane_depths()
-            self._reply(conn, send_lock, {
+            self._reply(conn, {
                 "type": "health", "id": rid, "ok": True,
                 "status": "degraded" if self.cold.degraded else "ok",
                 "covered_hi": idx.covered_hi,
@@ -1382,18 +1709,18 @@ class SieveService:
                 "refreshes": self._refreshes,
                 "draining": self._draining,
                 "range_lo": self.base,
-            })
+            }, front=True)
             return None
         if mtype == "stats":
-            self._reply(conn, send_lock,
+            self._reply(conn,
                         {"type": "stats", "id": rid, "ok": True,
-                         "stats": self.stats()})
+                         "stats": self.stats()}, front=True)
             return None
         if mtype == "shutdown":
             # rolling-restart control message: same path as SIGTERM
-            self._reply(conn, send_lock,
+            self._reply(conn,
                         {"type": "reply", "id": rid, "ok": True,
-                         "draining": True})
+                         "draining": True}, front=True)
             self.drain()
             return None
         if mtype == "metrics":
@@ -1401,20 +1728,20 @@ class SieveService:
             # snapshot, answered inline like health — the fleet poller
             # must see a wedged server's counters, not time out behind
             # its queue
-            self._reply(conn, send_lock, {
+            self._reply(conn, {
                 "type": "metrics", "id": rid, "ok": True,
                 "role": "service", "metrics": registry().snapshot(),
-            })
+            }, front=True)
             return None
         if mtype == "debug":
             # flight-recorder freeze (ISSUE 13): answered inline by the
-            # reader thread like metrics, so a wedged worker pool still
+            # event loop like metrics, so a wedged worker pool still
             # dumps its black box (no disk write, no throttle)
-            self._reply(conn, send_lock, {
+            self._reply(conn, {
                 "type": "debug", "id": rid, "ok": True, "role": "service",
                 "bundle": (self.recorder.snapshot("manual")
                            if self.recorder is not None else None),
-            })
+            }, front=True)
             return None
         if mtype == "telemetry":
             # explicit ring flush: the router pulls this from every
@@ -1431,7 +1758,7 @@ class SieveService:
                 self._bump("telemetry_replies")
             if msg.get("t_send") is not None:
                 reply["t_sent"] = round(trace.now_s(), 6)
-            self._reply(conn, send_lock, reply)
+            self._reply(conn, reply, front=True)
             return None
         if mtype == "chaos":
             if not self.settings.wire_chaos:
@@ -1439,26 +1766,27 @@ class SieveService:
                 # record who tried to fault-inject it over the wire
                 self.metrics.event("service_chaos_refused",
                                    spec=str(msg.get("spec", "")))
-                self._reply(conn, send_lock, {
+                self._reply(conn, {
                     "type": "reply", "id": rid, "ok": False,
                     "error": "bad_request",
                     "detail": "wire chaos injection is disabled on this "
                               "server (start it with --allow-chaos)",
-                })
+                }, front=True)
                 return None
             try:
                 n = self.inject_chaos(str(msg.get("spec", "")))
             except ValueError as e:
-                self._reply(conn, send_lock,
+                self._reply(conn,
                             {"type": "reply", "id": rid, "ok": False,
-                             "error": "bad_request", "detail": str(e)})
+                             "error": "bad_request", "detail": str(e)},
+                            front=True)
                 return None
-            self._reply(conn, send_lock,
+            self._reply(conn,
                         {"type": "reply", "id": rid, "ok": True,
-                         "injected": n})
+                         "injected": n}, front=True)
             return None
         if mtype != "query":
-            self._reply(conn, send_lock,
+            self._reply(conn,
                         {"type": "reply", "id": rid, "ok": False,
                          "error": "bad_request",
                          "detail": f"unknown message type {mtype!r}"})
@@ -1472,7 +1800,7 @@ class SieveService:
             # bad_request instead of manufacturing an already-expired
             # deadline and calling it deadline_exceeded
             self._bump("bad_requests")
-            self._reply(conn, send_lock, {
+            self._reply(conn, {
                 "type": "reply", "id": rid, "ok": False,
                 "op": str(msg.get("op", "")), "error": "bad_request",
                 "detail": f"deadline_s must be a positive number, "
@@ -1493,8 +1821,15 @@ class SieveService:
                 return "drop"  # dead replica: no reply, connection cut
             if d["kind"] == "svc_drain":
                 self.drain()
+            if d["kind"] == "svc_slow_frame":
+                # from this request on, replies to THIS connection are
+                # dribbled at param bytes per event-loop tick; other
+                # connections must stay at full speed (gated by test)
+                conn.throttle_bps = max(1.0, float(d["param"] or 1.0))
+                self.metrics.event("service_slow_frame", quietable=True,
+                                   bytes_per_tick=conn.throttle_bps)
         if any(d["kind"] == "svc_shed" for d in directives):
-            self._shed(conn, send_lock, rid, op, forced=True)
+            self._shed(conn, rid, op, forced=True)
             return None
         flood = next(
             (d for d in directives if d["kind"] == "svc_flood"), None
@@ -1504,7 +1839,7 @@ class SieveService:
             # named lane were at capacity: the deterministic injection of
             # the lane-shed surface (reply lane field, service_lane_shed
             # event, ReplicaSet failover) without a real 20-thread flood
-            self._shed(conn, send_lock, rid, op, forced=True,
+            self._shed(conn, rid, op, forced=True,
                        lane=str(flood["param"] or "cold"),
                        chaos_kind="svc_flood")
             return None
@@ -1514,7 +1849,7 @@ class SieveService:
             self.metrics.event("service_shed", quietable=True, op=op,
                                queue_depth=hot + cold,
                                reason="draining")
-            self._reply(conn, send_lock, {
+            self._reply(conn, {
                 "type": "reply", "id": rid, "ok": False, "op": op,
                 "error": "draining",
                 "detail": "server is draining (rolling restart); retry "
@@ -1523,18 +1858,18 @@ class SieveService:
             return None
         lane = self._classify(msg, idx)
         item = (msg, rid if rid is not None else seq, trace.now_s(),
-                directives, idx, conn, send_lock, lane, False)
+                directives, idx, conn, lane, False)
         with self._inflight_lock:
             self._inflight_n += 1
         if not self._lane_put(lane, item):
             with self._inflight_lock:
                 self._inflight_n -= 1
-            self._shed(conn, send_lock, rid, op, forced=False, lane=lane)
+            self._shed(conn, rid, op, forced=False, lane=lane)
             return None
         self._bump(f"{lane}_admitted")
         return None
 
-    def _shed(self, conn, send_lock, rid, op: str, forced: bool,
+    def _shed(self, conn: _Conn, rid, op: str, forced: bool,
               lane: str | None = None, chaos_kind: str = "svc_shed") -> None:
         hot, cold = self._lane_depths()
         depth = hot + cold
@@ -1565,7 +1900,7 @@ class SieveService:
         }
         if lane is not None:
             reply["lane"] = lane
-        self._reply(conn, send_lock, reply)
+        self._reply(conn, reply)
 
     # --- request handling ------------------------------------------------
 
@@ -1600,6 +1935,27 @@ class SieveService:
                 if hi < lo or hi > MAX_HI:
                     return "hot"
                 return "hot" if hi <= idx.covered_hi else "cold"
+            if op == "batch":
+                items = msg.get("items")
+                if (not isinstance(items, list) or not items
+                        or len(items) > self.settings.batch_queries):
+                    return "hot"  # whole-batch typed bad_request
+                vs: list[int] = []
+                for m in items:
+                    if not isinstance(m, dict):
+                        continue  # per-member typed bad_request, cheap
+                    try:
+                        mop = m.get("op")
+                        if mop == "pi":
+                            vs.append(int(m["x"]) + 1)
+                        elif mop == "is_prime":
+                            x = int(m["x"])
+                            vs.extend((x, x + 1))
+                        elif mop == "count":
+                            vs.extend((int(m["lo"]), int(m["hi"])))
+                    except (KeyError, TypeError, ValueError):
+                        continue
+                return self._lane_for_prefixes(vs, idx) if vs else "hot"
         except (KeyError, TypeError, ValueError):
             return "hot"  # malformed → typed bad_request, cheap
         return "hot"  # unknown op → typed bad_request
@@ -1641,15 +1997,15 @@ class SieveService:
             except Exception:
                 pass  # _handle replies "internal" itself; never die
 
-    def _requeue_cold(self, msg, rid, enq_t, idx, conn, send_lock) -> bool:
+    def _requeue_cold(self, msg, rid, enq_t, idx, conn) -> bool:
         """Demotion (ISSUE 10): re-enqueue a misclassified hot request on
         the cold lane. The original enq_t rides along, so its deadline
         keeps draining and cold-lane aging sees its true wait."""
-        item = (msg, rid, enq_t, (), idx, conn, send_lock, "cold", True)
+        item = (msg, rid, enq_t, (), idx, conn, "cold", True)
         return self._lane_put("cold", item)
 
     def _handle(self, msg, rid, enq_t, directives, idx,
-                conn, send_lock, lane: str = "cold",
+                conn: _Conn, lane: str = "cold",
                 demoted: bool = False) -> None:
         # ``idx`` is the snapshot captured at admission: the whole request
         # runs on it even if the follower swaps self.index mid-flight
@@ -1693,7 +2049,7 @@ class SieveService:
             check()
             reply["value"] = self._execute(op, msg, ctx, deadline, idx)
         except _Demoted as e:
-            if self._requeue_cold(msg, rid, enq_t, idx, conn, send_lock):
+            if self._requeue_cold(msg, rid, enq_t, idx, conn):
                 self._bump("demoted")
                 self.metrics.event("service_demoted", quietable=True,
                                    op=op, chunks=e.chunks)
@@ -1787,7 +2143,7 @@ class SieveService:
         if msg.get("t_send") is not None:
             reply["t_sent"] = round(trace.now_s(), 6)
         try:
-            self._reply(conn, send_lock, reply)
+            self._reply(conn, reply)
         finally:
             # drain accounting: this admitted query is now answered
             with self._inflight_lock:
@@ -1844,10 +2200,141 @@ class SieveService:
             if hi > lo:
                 self._check_base(op, lo)
             return self._primes(lo, hi, ctx, deadline, idx)
+        if op == "batch":
+            return self._execute_batch(msg, ctx, deadline, idx)
         raise BadRequest(
             f"unknown op {op!r} (one of pi, is_prime, count, nth_prime, "
-            "primes)"
+            "primes, batch)"
         )
+
+    def _execute_batch(self, msg: dict, ctx: QueryCtx, deadline: float,
+                       idx: SieveIndex) -> list[dict]:
+        """Vectorized batch op (ISSUE 14): M prefix/interval/is_prime
+        members answered as per-member typed outcomes.
+
+        Every member decomposes into prefix counts P(v) = primes in
+        [base, v): pi(x) = P(x+1), count(lo,hi) = P(hi) - P(lo),
+        is_prime(x) = P(x+1) - P(x) > 0. The distinct v's are deduped,
+        every hot one (≤ covered_hi) is answered by ONE
+        ``np.searchsorted`` row over the index prefix
+        (:meth:`SieveIndex.count_upto_batch`), and cold ones walk the
+        existing scalar path — ascending, so the ColdBatcher coalesces
+        their chunk flights — each catching its typed fault
+        individually. A member whose values all resolved replies
+        ``{"ok": True, "value": ...}``; one touching a faulted value
+        replies ``{"ok": False, "error": <kind>, ...}`` (deadline
+        members carry the prefix partial). Malformed members are typed
+        per-member; a malformed items container or an oversized batch
+        is a whole-batch bad_request. ``_Demoted`` propagates whole-
+        batch so the standard demotion path re-runs it on the cold
+        lane."""
+        items = msg.get("items")
+        if not isinstance(items, list) or not items:
+            raise BadRequest("batch: items must be a non-empty list")
+        if len(items) > self.settings.batch_queries:
+            raise BadRequest(
+                f"batch: {len(items)} members exceed "
+                f"batch_queries={self.settings.batch_queries}"
+            )
+        self._bump("batch_requests")
+        self._bump("batch_members", len(items))
+        # plan each member: ("err", outcome) | (mop, needed_vals, finish)
+        plans: list[tuple] = []
+        needed: set[int] = set()
+        for m in items:
+            mop = str(m.get("op", "")) if isinstance(m, dict) else ""
+            try:
+                if not isinstance(m, dict):
+                    raise BadRequest("batch member must be an object")
+                if mop == "pi":
+                    if self.base > 2:
+                        raise BadRequest(
+                            f"pi is a global-prefix op; this server "
+                            f"serves [{self.base}, ...) — use "
+                            "count(lo, hi) or query the router"
+                        )
+                    x = _req_int(m, "x")
+                    if x < 0 or x + 1 > MAX_HI:
+                        raise BadRequest(
+                            f"pi({x}): x must be in [0, {MAX_HI})"
+                        )
+                    plans.append((mop, (x + 1,), lambda p: p[0]))
+                elif mop == "is_prime":
+                    x = _req_int(m, "x")
+                    if x + 1 > MAX_HI:
+                        raise BadRequest(
+                            f"is_prime({x}): x must be < {MAX_HI}"
+                        )
+                    if x < 2:
+                        plans.append((mop, (), lambda p: False))
+                        continue
+                    self._check_base(mop, x)
+                    plans.append(
+                        (mop, (x, x + 1), lambda p: p[1] - p[0] > 0)
+                    )
+                elif mop == "count":
+                    lo, hi = _req_int(m, "lo"), _req_int(m, "hi")
+                    if hi > MAX_HI:
+                        raise BadRequest(f"count: hi={hi} exceeds {MAX_HI}")
+                    if hi < lo:
+                        raise BadRequest(f"count: hi={hi} < lo={lo}")
+                    if str(m.get("kind", "primes")) != "primes":
+                        raise BadRequest(
+                            "batch count members support kind=primes only"
+                        )
+                    if hi > lo:
+                        self._check_base(mop, lo)
+                    plans.append((mop, (lo, hi), lambda p: p[1] - p[0]))
+                else:
+                    raise BadRequest(
+                        f"unknown batch member op {mop!r} "
+                        "(one of pi, is_prime, count)"
+                    )
+            except BadRequest as e:
+                plans.append(("err", {
+                    "ok": False, "op": mop, "error": "bad_request",
+                    "detail": str(e), "partial": None,
+                }))
+                continue
+            needed.update(plans[-1][1])
+        # resolve the deduped prefix values: one vectorized gather for
+        # the hot set, then the cold tail ascending
+        res: dict[int, int] = {}
+        faults: dict[int, dict] = {}
+        hot = sorted(v for v in needed
+                     if self.base < v <= idx.covered_hi)
+        for v in needed:
+            if v <= self.base:
+                res[v] = 0
+        if hot:
+            counts = idx.count_upto_batch(hot, ctx)
+            for v, c in zip(hot, counts):
+                res[v] = int(c)
+        for v in sorted(v for v in needed if v > idx.covered_hi):
+            try:
+                res[v] = self._count_upto(v, ctx, deadline, idx)
+            except _Demoted:
+                raise  # whole batch re-runs on the cold lane
+            except tuple(_ERROR_KIND) as e:
+                fault = {"error": _ERROR_KIND[type(e)], "detail": str(e),
+                         "partial": None}
+                if isinstance(e, DeadlineExceeded):
+                    fault["partial"] = {"answered_hi": e.answered_hi,
+                                       "count_so_far": e.count_so_far}
+                faults[v] = fault
+        out: list[dict] = []
+        for plan in plans:
+            if plan[0] == "err":
+                out.append(plan[1])
+                continue
+            mop, vals, finish = plan
+            bad = next((v for v in vals if v in faults), None)
+            if bad is not None:
+                out.append({"ok": False, "op": mop, **faults[bad]})
+            else:
+                out.append({"ok": True, "op": mop,
+                            "value": finish([res[v] for v in vals])})
+        return out
 
     def _check_base(self, op: str, lo: int) -> None:
         """Range-sharded servers reject queries below their shard."""

@@ -160,7 +160,8 @@ def test_health_and_stats_key_schema_snapshot(service):
         "snapshot_age_s", "status", "total_primes", "type",
     ]
     assert sorted(cli.stats()) == [
-        "bad_requests", "brownout", "coalesced", "cold_admitted",
+        "bad_requests", "batch_members", "batch_requests", "brownout",
+        "coalesced", "cold_admitted",
         "cold_batched_chunks", "cold_cache_hits", "cold_computes",
         "cold_dispatches", "cold_persisted", "covered_hi",
         "deadline_exceeded", "degraded", "degraded_replies", "demoted",
@@ -170,7 +171,8 @@ def test_health_and_stats_key_schema_snapshot(service):
         "lru_entries", "lru_hits", "materialized", "persist_cold",
         "queue_depth", "queue_depth_cold", "queue_depth_hot", "range_lo",
         "refresh_attempts", "refresh_failed", "refreshes", "requests",
-        "segments", "shed", "slo", "snapshot_age_s", "telemetry_replies",
+        "segments", "shed", "slo", "slow_consumer_closed",
+        "snapshot_age_s", "telemetry_replies",
         "total_primes", "trace_drops",
     ]
 

@@ -1,0 +1,738 @@
+"""The multiplexed wire plane (ISSUE 14): pipelined framing, the
+``batch`` op, and connection-level isolation.
+
+Covers: the incremental :class:`~sieve.rpc.FrameDecoder` (byte-by-byte
+feeds, multi-frame feeds, oversized/garbage frames); pipelined reply
+correlation by id under out-of-order completion; mid-pipeline typed
+sheds and deadline partials landing on the RIGHT ids; inline ops
+(health/stats) overtaking queued query replies; the ``svc_slow_frame``
+chaos kind and a raw-socket slowloris proving one dribbling connection
+never head-of-line blocks another; the bounded write queue killing slow
+consumers typed; the vectorized ``batch`` op on server and router
+(exactness vs oracle, per-member typed outcomes, the ≤1-RPC-per-shard
+scatter contract gated on the ``batch_rpcs`` counter, totals-cache
+fill); :meth:`ReplicaSet.query_many` suffix-only failover;
+:class:`ClientPool` connection reuse; the ``tools/check_wire_ops``
+parity gate; and the bench_compare ``qps`` regression rule.
+"""
+
+import socket
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sieve import metrics
+from sieve.checkpoint import Ledger
+from sieve.config import SieveConfig
+from sieve.coordinator import run_local
+from sieve.metrics import MemorySink, validate_record
+from sieve.rpc import MAX_FRAME, FrameDecoder, encode_msg, recv_msg
+from sieve.seed import seed_primes
+from sieve.service import (
+    ClientPool,
+    QueryCtx,
+    ReplicaSet,
+    RouterSettings,
+    ServiceClient,
+    ServiceError,
+    ServiceSettings,
+    Shard,
+    ShardMap,
+    SieveIndex,
+    SieveRouter,
+    SieveService,
+)
+
+REPO = Path(__file__).parent.parent
+N = 50_000
+P = seed_primes(200_000)
+
+
+def o_pi(x):
+    return int(np.searchsorted(P, x, side="right"))
+
+
+def o_count(lo, hi):
+    return int(np.searchsorted(P, hi, side="left")
+               - np.searchsorted(P, lo, side="left"))
+
+
+def o_is_prime(x):
+    return o_pi(x) - o_pi(x - 1) > 0
+
+
+@pytest.fixture
+def memsink():
+    sink = MemorySink()
+    metrics.add_sink(sink)
+    yield sink
+    metrics.remove_sink(sink)
+
+
+@pytest.fixture(scope="module")
+def ledger_dir(tmp_path_factory):
+    path = tmp_path_factory.mktemp("wire_ledger")
+    run_local(_cfg(str(path)))
+    return path
+
+
+def _cfg(checkpoint_dir: str, **kw) -> SieveConfig:
+    base = dict(
+        n=N, backend="cpu-numpy", packing="wheel30", n_segments=4,
+        quiet=True, checkpoint_dir=checkpoint_dir,
+    )
+    base.update(kw)
+    return SieveConfig(**base)
+
+
+def _settings(**kw) -> ServiceSettings:
+    base = dict(
+        workers=2, queue_limit=16, default_deadline_s=10.0,
+        cold_chunk=1 << 16, breaker_cooldown_s=0.4, refresh_s=0.0,
+    )
+    base.update(kw)
+    return ServiceSettings(**base)
+
+
+@pytest.fixture
+def service(ledger_dir):
+    with SieveService(_cfg(str(ledger_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            yield svc, cli
+
+
+def _dead_addr():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here anymore
+    return f"127.0.0.1:{port}"
+
+
+# --- FrameDecoder ------------------------------------------------------------
+
+
+def test_frame_decoder_byte_by_byte():
+    msgs = [{"type": "query", "op": "pi", "x": 10**9},
+            {"type": "health", "id": 7}]
+    wire = b"".join(encode_msg(m) for m in msgs)
+    dec = FrameDecoder()
+    got = []
+    for i in range(len(wire)):
+        got.extend(dec.feed(wire[i:i + 1]))
+    assert got == msgs
+    assert dec.buffered() == 0
+
+
+def test_frame_decoder_many_frames_one_feed():
+    msgs = [{"id": i, "v": "x" * i} for i in range(5)]
+    wire = b"".join(encode_msg(m) for m in msgs)
+    tail = encode_msg({"id": 99})
+    dec = FrameDecoder()
+    # every complete frame pops at once; the partial tail stays buffered
+    got = dec.feed(wire + tail[:-3])
+    assert got == msgs
+    assert dec.buffered() == len(tail) - 3
+    assert dec.feed(tail[-3:]) == [{"id": 99}]
+    assert dec.buffered() == 0
+
+
+def test_frame_decoder_oversized_frame_is_typed():
+    header = (MAX_FRAME + 1).to_bytes(8, "big")
+    with pytest.raises(ValueError, match="frame"):
+        FrameDecoder().feed(header)
+
+
+def test_frame_decoder_garbage_body_is_typed():
+    body = b"not json at all"
+    frame = len(body).to_bytes(8, "big") + body
+    with pytest.raises(ValueError):
+        FrameDecoder().feed(frame)
+
+
+# --- pipelined correlation ---------------------------------------------------
+
+
+def test_pipelined_replies_correlate_out_of_order(ledger_dir):
+    """A slow cold query submitted FIRST must not delay — or steal the
+    replies of — hot queries pipelined behind it on the same socket."""
+    settings = _settings(workers=4, cold_delay_s=0.4)
+    with SieveService(_cfg(str(ledger_dir)), settings) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            cold_id = cli.submit("pi", x=90_000)
+            hot = [(cli.submit("pi", x=x), x)
+                   for x in (100, 5_000, 12_345, 30_000)]
+            t0 = time.monotonic()
+            hot_replies = cli.drain([rid for rid, _ in hot])
+            hot_elapsed = time.monotonic() - t0
+            # the hot replies completed (and were collected) while the
+            # cold leader was still inside its simulated 0.4 s compute
+            assert hot_elapsed < 0.4
+            assert cli.pending() == 1
+            for rid, x in hot:
+                r = hot_replies[rid]
+                assert r["ok"] and r["id"] == rid
+                assert r["value"] == o_pi(x)
+            cold = cli.drain([cold_id])[cold_id]
+            assert cold["ok"] and cold["value"] == o_pi(90_000)
+            assert cli.pending() == 0
+
+
+def test_pipelined_deep_inflight_all_exact(ledger_dir):
+    # queue sized above the pipeline depth: this measures correlation,
+    # not admission control
+    with SieveService(_cfg(str(ledger_dir)),
+                      _settings(queue_limit=256)) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            xs = [(7919 * (i + 1)) % N for i in range(64)]
+            ids = [cli.submit("pi", x=x) for x in xs]
+            assert cli.pending() == 64
+            replies = cli.drain()
+            assert cli.pending() == 0
+            for rid, x in zip(ids, xs):
+                assert replies[rid]["value"] == o_pi(x), x
+
+
+def test_mid_pipeline_shed_lands_on_the_right_id(service):
+    svc, cli = service
+    # the 3rd of 5 pipelined requests is shed; its neighbors answer exact
+    svc.inject_chaos(f"svc_shed:any@s{svc._seq + 3}")
+    xs = [100, 5_000, 12_345, 30_000, 45_000]
+    ids = [cli.submit("pi", x=x) for x in xs]
+    replies = cli.drain(ids)
+    for k, (rid, x) in enumerate(zip(ids, xs)):
+        r = replies[rid]
+        if k == 2:
+            assert r["ok"] is False and r["error"] == "overloaded"
+            assert "svc_shed" in r["detail"]
+        else:
+            assert r["ok"] and r["value"] == o_pi(x)
+
+
+def test_mid_pipeline_deadline_partial_lands_on_the_right_id(ledger_dir):
+    with SieveService(_cfg(str(ledger_dir)),
+                      _settings(workers=1)) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            svc.inject_chaos(f"svc_stall:any@s{svc._seq + 1}:0.6")
+            stalled = cli.submit("pi", x=30_000, deadline_s=0.2)
+            ids = [cli.submit("pi", x=x) for x in (100, 12_345)]
+            replies = cli.drain([stalled, *ids])
+            r = replies[stalled]
+            assert r["error"] == "deadline_exceeded"
+            assert isinstance(r["partial"], dict)
+            assert r["partial"]["answered_hi"] >= 2
+            for rid, x in zip(ids, (100, 12_345)):
+                assert replies[rid]["value"] == o_pi(x)
+
+
+def test_inline_ops_overtake_queued_work(ledger_dir):
+    """health/stats are answered by the event loop ahead of the queue:
+    they return while every pipelined query is still in flight."""
+    settings = _settings(workers=1, cold_delay_s=0.5)
+    with SieveService(_cfg(str(ledger_dir)), settings) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            ids = [cli.submit("pi", x=90_000 + 10_000 * i)
+                   for i in range(3)]
+            h = cli.health()
+            s = cli.stats()
+            # no query reply arrived before the inline ones: all three
+            # are still pending and nothing is stashed
+            assert h["ok"] and "queue_depth" in h
+            assert s["hot_admitted"] + s["cold_admitted"] >= 1
+            assert cli.pending() == 3
+            assert not cli._replies
+            replies = cli.drain(ids)
+            for i, rid in enumerate(ids):
+                assert replies[rid]["value"] == o_pi(90_000 + 10_000 * i)
+
+
+def test_inline_reply_never_lost_mid_direct_send(service):
+    """Regression: a worker's direct send keeps head_off at 0 until
+    send() returns, so a concurrently front-inserted inline reply used
+    to land at index 0 mid-send and get destroyed by the sender's
+    popleft (the client then hung waiting for it). Hammer the exact
+    interleaving: a hot query reply direct-sent by a worker racing a
+    loop-inserted health reply on the same connection."""
+    svc, _ = service
+    with ServiceClient(svc.addr, timeout_s=5) as cli:
+        for i in range(60):
+            rid = cli.submit("pi", x=20_000 + (i % 7))
+            h = cli.health()
+            assert h["ok"]
+            reply = cli.drain([rid])[rid]
+            assert reply["value"] == o_pi(20_000 + (i % 7))
+
+
+# --- one slow connection never blocks another --------------------------------
+
+
+def test_svc_slow_frame_throttles_one_conn_not_the_fleet(
+        ledger_dir, memsink):
+    with SieveService(_cfg(str(ledger_dir)),
+                      _settings(workers=4)) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as slow, \
+                ServiceClient(svc.addr, timeout_s=30) as fast:
+            fast.pi(100)  # warm both the index and fast's connection
+            # the NEXT query is slow's, submitted before any fast
+            # traffic, so the throttle deterministically lands on
+            # slow's connection (2 bytes per 5 ms tick: a >=100-byte
+            # reply frame needs >=0.25 s to dribble out)
+            svc.inject_chaos(f"svc_slow_frame:any@s{svc._seq + 1}:2")
+            rid = slow.submit("pi", x=30_000)
+            time.sleep(0.05)  # the server has taken the directive
+            box = {}
+
+            def dribbled():
+                t0 = time.monotonic()
+                box["value"] = slow.drain([rid])[rid]["value"]
+                box["elapsed"] = time.monotonic() - t0
+
+            t = threading.Thread(target=dribbled)
+            t.start()
+            lat = []
+            while t.is_alive():
+                q0 = time.monotonic()
+                assert fast.pi(12_345) == o_pi(12_345)
+                lat.append(time.monotonic() - q0)
+            t.join(30)
+            # the dribbled reply is exact and SLOW; the other
+            # connection stayed at full wire speed throughout
+            assert box["value"] == o_pi(30_000)
+            assert box["elapsed"] >= 0.1
+            assert len(lat) >= 3
+            p95 = sorted(lat)[max(0, int(len(lat) * 0.95) - 1)]
+            assert p95 < box["elapsed"] / 2
+    ev = [x for x in memsink.records
+          if x["event"] == "service_slow_frame"]
+    assert ev and ev[0]["bytes_per_tick"] == 2.0
+    for x in ev:
+        validate_record(x)
+
+
+def test_slowloris_reader_never_blocks_normal_clients(service):
+    """A client dribbling its REQUEST one byte at a time holds its
+    connection open for ~0.4 s; a normal client on another connection
+    keeps full-speed service the whole time (non-blocking reads), and
+    the dribbled request still answers exact once complete."""
+    svc, cli = service
+    frame = encode_msg({"type": "query", "id": 1, "op": "pi", "x": 30_000})
+    host, port = svc.addr.split(":")
+    loris = socket.create_connection((host, int(port)), timeout=30)
+    try:
+        done = threading.Event()
+
+        def dribble():
+            try:
+                for i in range(len(frame)):
+                    loris.sendall(frame[i:i + 1])
+                    time.sleep(0.4 / len(frame))
+            finally:
+                done.set()
+
+        t = threading.Thread(target=dribble)
+        t.start()
+        cli.pi(100)  # warm
+        lat = []
+        while not done.is_set():
+            q0 = time.monotonic()
+            assert cli.pi(12_345) == o_pi(12_345)
+            lat.append(time.monotonic() - q0)
+        t.join(30)
+        assert len(lat) >= 5
+        p95 = sorted(lat)[max(0, int(len(lat) * 0.95) - 1)]
+        assert p95 < 0.2  # normal traffic never waited on the slowloris
+        reply = recv_msg(loris)
+        assert reply["id"] == 1 and reply["value"] == o_pi(30_000)
+    finally:
+        loris.close()
+
+
+def test_slow_consumer_overflowing_write_queue_is_killed(
+        ledger_dir, memsink):
+    # a reply bigger than the whole write-queue budget can never drain:
+    # the server closes the connection instead of buffering unboundedly
+    with SieveService(_cfg(str(ledger_dir)),
+                      _settings(write_queue_bytes=4096)) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            with pytest.raises((ConnectionError, OSError)):
+                cli.primes(2, 30_000)  # ~23 KB reply > 4 KB queue
+        with ServiceClient(svc.addr, timeout_s=30) as cli2:
+            assert cli2.stats()["slow_consumer_closed"] == 1
+            assert cli2.pi(100) == o_pi(100)  # the server itself is fine
+    ev = [x for x in memsink.records
+          if x["event"] == "service_slow_consumer"]
+    assert ev and ev[0]["limit"] == 4096
+    for x in ev:
+        validate_record(x)
+
+
+# --- the batch op, single server ---------------------------------------------
+
+
+def test_batch_exact_vs_oracle_hot_and_cold(service):
+    svc, cli = service
+    covered = svc.index.covered_hi
+    items = [
+        {"op": "pi", "x": 0},
+        {"op": "pi", "x": 30_000},                 # hot interior
+        {"op": "pi", "x": covered - 1},            # hot boundary
+        {"op": "pi", "x": 90_000},                 # cold
+        {"op": "is_prime", "x": 1},
+        {"op": "is_prime", "x": 2},
+        {"op": "is_prime", "x": 12_347},
+        {"op": "count", "lo": 10_000, "hi": 40_000},
+        {"op": "count", "lo": 40_000, "hi": 90_000},  # straddles covered
+        {"op": "count", "lo": 7, "hi": 7},
+    ]
+    s0 = cli.stats()
+    out = cli.query_batch(items)
+    s1 = cli.stats()
+    assert s1["batch_requests"] == s0["batch_requests"] + 1
+    assert s1["batch_members"] == s0["batch_members"] + len(items)
+    assert [o["ok"] for o in out] == [True] * len(items)
+    assert [o["value"] for o in out] == [
+        0, o_pi(30_000), o_pi(covered - 1), o_pi(90_000),
+        False, True, o_is_prime(12_347),
+        o_count(10_000, 40_000), o_count(40_000, 90_000), 0,
+    ]
+    assert [o["op"] for o in out] == [i["op"] for i in items]
+
+
+def test_batch_malformed_members_fault_individually(service):
+    _svc, cli = service
+    out = cli.query_batch([
+        {"op": "pi", "x": 100},
+        {"op": "nth_prime", "k": 3},          # not a batchable op
+        {"op": "count", "lo": 2, "hi": 100, "kind": "twins"},
+        {"op": "is_prime"},                    # missing x
+        "not an object",
+        {"op": "pi", "x": 200},
+    ])
+    assert out[0]["value"] == o_pi(100)
+    assert out[5]["value"] == o_pi(200)
+    for k in (1, 2, 3, 4):
+        assert out[k]["ok"] is False
+        assert out[k]["error"] == "bad_request"
+    assert "nth_prime" in out[1]["detail"]
+    assert "kind=primes" in out[2]["detail"]
+
+
+def test_batch_container_faults_are_whole_batch(ledger_dir):
+    with SieveService(_cfg(str(ledger_dir)),
+                      _settings(batch_queries=4)) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            r = cli.query("batch", items="nope")
+            assert r["ok"] is False and r["error"] == "bad_request"
+            r = cli.query("batch", items=[])
+            assert r["error"] == "bad_request"
+            with pytest.raises(ServiceError) as ei:
+                cli.query_batch([{"op": "pi", "x": x}
+                                 for x in range(5)])  # 5 > batch_queries=4
+            assert ei.value.kind == "bad_request"
+            assert "batch_queries=4" in ei.value.detail
+            # at the cap is fine
+            out = cli.query_batch([{"op": "pi", "x": x}
+                                   for x in (10, 20, 30, 40)])
+            assert [o["value"] for o in out] == [o_pi(x)
+                                                 for x in (10, 20, 30, 40)]
+
+
+def test_batch_cold_member_faults_spare_hot_members(service):
+    svc, cli = service
+    svc.inject_chaos(f"backend_down:any@s{svc._seq + 1}:0.6")
+    out = cli.query_batch([
+        {"op": "pi", "x": 30_000},
+        {"op": "pi", "x": 90_000},  # needs a fresh cold chunk
+    ])
+    assert out[0]["ok"] and out[0]["value"] == o_pi(30_000)
+    assert out[1]["ok"] is False and out[1]["error"] == "degraded"
+
+
+def test_batch_deadline_member_carries_partial(ledger_dir):
+    # the cold member's 0.5 s simulated compute blows the 0.2 s budget
+    # INSIDE the batch, after the hot members already resolved
+    with SieveService(_cfg(str(ledger_dir)),
+                      _settings(cold_delay_s=0.5)) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            out = cli.query_batch(
+                [{"op": "pi", "x": 30_000}, {"op": "pi", "x": 90_000}],
+                deadline_s=0.2,
+            )
+            # hot members never blow a deadline on the index row; the
+            # cold member's fault carries the prefix partial
+            assert out[0]["ok"] and out[0]["value"] == o_pi(30_000)
+            assert out[1]["error"] == "deadline_exceeded"
+            assert isinstance(out[1]["partial"], dict)
+            assert out[1]["partial"]["answered_hi"] >= 2
+
+
+def test_count_upto_batch_matches_scalar(ledger_dir):
+    led = Ledger.open_readonly(_cfg(str(ledger_dir)))
+    idx = SieveIndex("wheel30", led.completed())
+    vs = sorted({2, 3, 100, 12_345, 30_001, idx.covered_hi, *idx.bounds})
+    got = idx.count_upto_batch(vs, QueryCtx())
+    assert got.dtype == np.int64
+    for v, g in zip(vs, got.tolist()):
+        assert g == idx.count_upto(v, QueryCtx()) == o_pi(v - 1), v
+    assert idx.count_upto_batch([], QueryCtx()).size == 0
+    with pytest.raises(ValueError, match="beyond covered_hi"):
+        idx.count_upto_batch([idx.covered_hi + 1], QueryCtx())
+
+
+# --- ReplicaSet: pipelined failover ------------------------------------------
+
+
+def test_query_many_pipelines_in_request_order(ledger_dir):
+    with SieveService(_cfg(str(ledger_dir)),
+                      _settings(queue_limit=64)) as svc:
+        with ReplicaSet([svc.addr], timeout_s=30) as rs:
+            reqs = [{"op": "pi", "x": 100},
+                    {"op": "count", "lo": 10_000, "hi": 40_000},
+                    {"op": "is_prime", "x": 12_347},
+                    {"op": "pi", "x": 30_000}]
+            out = rs.query_many(reqs, window=2)
+            assert [r["ok"] for r in out] == [True] * 4
+            assert out[0]["value"] == o_pi(100)
+            assert out[1]["value"] == o_count(10_000, 40_000)
+            assert bool(out[2]["value"]) == o_is_prime(12_347)
+            assert out[3]["value"] == o_pi(30_000)
+            for r in out:
+                assert r["probe"]["addr"] == svc.addr
+                assert r["probe"]["t_done"] >= r["probe"]["t_send"]
+            assert rs.failovers == 0
+
+
+def test_query_many_mid_pipeline_kill_fails_over_suffix_only(ledger_dir):
+    cfg = _cfg(str(ledger_dir))
+    with SieveService(cfg, _settings()) as a, \
+            SieveService(cfg, _settings()) as b:
+        with ReplicaSet([a.addr, b.addr], timeout_s=30,
+                        circuit_cooldown_s=5.0) as rs:
+            # replica A's 3rd query cuts the connection (dead-replica
+            # chaos) and keeps dropping new ones for 0.5 s, so the
+            # unanswered suffix must fail over to B
+            a.inject_chaos(f"replica_down:any@s{a._seq + 3}:0.5")
+            reqs = [{"op": "pi", "x": x}
+                    for x in (100, 5_000, 12_345, 30_000, 45_000, 49_999)]
+            out = rs.query_many(reqs, window=2)
+            assert [r["value"] for r in out] == [o_pi(r["x"])
+                                                 for r in reqs]
+            addrs = [r["probe"]["addr"] for r in out]
+            # the head was answered by A before the kill and is KEPT —
+            # only the unanswered suffix moved to B
+            assert addrs[0] == a.addr
+            assert addrs[2:] == [b.addr] * 4
+            assert rs.failovers >= 1
+
+
+def test_query_many_typed_finals_and_unavailable(ledger_dir):
+    with SieveService(_cfg(str(ledger_dir)), _settings()) as svc:
+        with ReplicaSet([svc.addr], timeout_s=30) as rs:
+            out = rs.query_many([{"op": "pi", "x": 100},
+                                 {"op": "nope"}])
+            assert out[0]["value"] == o_pi(100)
+            assert out[1]["error"] == "bad_request"  # final, not retried
+            assert rs.failovers == 0
+    with ReplicaSet([_dead_addr()], timeout_s=2, rounds=1,
+                    probe_timeout_s=0.5) as rs:
+        out = rs.query_many([{"op": "pi", "x": 100}])
+        assert out[0]["ok"] is False
+        assert out[0]["error"] == "unavailable"
+        assert "no replica answered" in out[0]["detail"]
+
+
+def test_replicaset_query_batch_fails_over_whole_rpc(ledger_dir):
+    with SieveService(_cfg(str(ledger_dir)), _settings()) as svc:
+        with ReplicaSet([_dead_addr(), svc.addr], timeout_s=30,
+                        probe_timeout_s=0.5) as rs:
+            out = rs.query_batch([{"op": "pi", "x": 100},
+                                  {"op": "is_prime", "x": 12_347}])
+            assert out[0]["value"] == o_pi(100)
+            assert bool(out[1]["value"]) == o_is_prime(12_347)
+
+
+# --- ClientPool --------------------------------------------------------------
+
+
+def test_client_pool_reuses_connections_across_cycles(ledger_dir):
+    cfg = _cfg(str(ledger_dir))
+    with SieveService(cfg, _settings()) as a, \
+            SieveService(cfg, _settings()) as b:
+        with ClientPool(timeout_s=10) as pool:
+            first = {addr: pool.get(addr) for addr in (a.addr, b.addr)}
+            for _ in range(3):  # three refresh cycles, zero new sockets
+                for addr in (a.addr, b.addr):
+                    cli = pool.get(addr)
+                    assert cli is first[addr]
+                    assert cli.health()["ok"]
+            assert pool.connects == 2
+            assert pool.reconnects == 0
+            # a transport failure invalidates ONE entry; only that
+            # endpoint reconnects (and the reconnect is counted)
+            pool.invalidate(a.addr)
+            assert pool.get(a.addr) is not first[a.addr]
+            assert pool.get(b.addr) is first[b.addr]
+            assert (pool.connects, pool.reconnects) == (3, 1)
+            # a client that died in place (server cut it) also
+            # reconnects on the next get
+            pool.get(a.addr).close()
+            assert pool.get(a.addr).health()["ok"]
+            assert (pool.connects, pool.reconnects) == (4, 2)
+
+
+# --- the batch op, routed ----------------------------------------------------
+
+
+class _Fabric:
+    """Two-shard in-process fabric (split 2+2 segments at E)."""
+
+    def __init__(self, ledger_dir, tmp_path, shard1_dead=False,
+                 router_settings=None):
+        segs = sorted(
+            Ledger.open_readonly(_cfg(str(ledger_dir)))
+            .completed().values(),
+            key=lambda r: r.lo,
+        )
+        self.E = segs[2].lo
+        dirs = (tmp_path / "shard0", tmp_path / "shard1")
+        for d, part in zip(dirs, (segs[:2], segs[2:])):
+            led = Ledger.open(_cfg(str(d)))
+            for r in part:
+                led.record(r)
+        self.svcs = [
+            SieveService(_cfg(str(dirs[0])), _settings()).start()
+        ]
+        if shard1_dead:
+            s1_addrs = (_dead_addr(),)
+        else:
+            self.svcs.append(
+                SieveService(_cfg(str(dirs[1])),
+                             _settings(range_lo=self.E)).start()
+            )
+            s1_addrs = (self.svcs[1].addr,)
+        self.map = ShardMap([
+            Shard(2, self.E, (self.svcs[0].addr,)),
+            Shard(self.E, N + 1, s1_addrs),
+        ])
+        self.router = SieveRouter(
+            self.map,
+            router_settings or RouterSettings(quiet=True),
+        ).start()
+        self.cli = ServiceClient(self.router.addr, timeout_s=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.cli.close()
+        self.router.stop()
+        for s in self.svcs:
+            s.stop()
+
+
+def test_router_batch_one_rpc_per_shard(ledger_dir, tmp_path):
+    with _Fabric(ledger_dir, tmp_path) as f:
+        items = [
+            {"op": "pi", "x": 100},                      # shard 0 only
+            {"op": "pi", "x": f.E + 5_000},              # both shards
+            {"op": "count", "lo": 100, "hi": f.E + 200},  # straddles E
+            {"op": "count", "lo": f.E + 10, "hi": N},    # shard 1 only
+            {"op": "is_prime", "x": 12_347},
+            {"op": "is_prime", "x": f.E + 7},
+            {"op": "pi", "x": 1},
+        ]
+        s0 = f.cli.stats()
+        out = f.cli.query_batch(items)
+        s1 = f.cli.stats()
+        # the scatter contract: 7 members over 2 shards cost at most
+        # ONE downstream batch RPC per shard
+        assert s1["batch_rpcs"] - s0["batch_rpcs"] <= 2
+        assert s1["batch_requests"] - s0["batch_requests"] == 1
+        assert s1["batch_members"] - s0["batch_members"] == len(items)
+        assert [o["ok"] for o in out] == [True] * len(items)
+        assert out[0]["value"] == o_pi(100)
+        assert out[1]["value"] == o_pi(f.E + 5_000)
+        assert out[2]["value"] == o_count(100, f.E + 200)
+        assert out[3]["value"] == o_count(f.E + 10, N)
+        assert out[4]["value"] is o_is_prime(12_347)
+        assert out[5]["value"] is o_is_prime(f.E + 7)
+        assert out[6]["value"] == 0
+        # point members confined to one shard touch ONE shard
+        s2 = f.cli.stats()
+        f.cli.query_batch([{"op": "is_prime", "x": x}
+                           for x in (101, 103, 107)])
+        s3 = f.cli.stats()
+        assert s3["batch_rpcs"] - s2["batch_rpcs"] == 1
+
+
+def test_router_batch_fills_and_uses_totals_cache(ledger_dir, tmp_path):
+    with _Fabric(ledger_dir, tmp_path) as f:
+        s0 = f.cli.stats()
+        out = f.cli.query_batch([{"op": "pi", "x": N}])
+        s1 = f.cli.stats()
+        assert out[0]["value"] == o_pi(N)
+        assert s1["batch_rpcs"] - s0["batch_rpcs"] == 2  # both totals miss
+        # the full-shard counts rode the batch and filled the totals
+        # cache: the SAME batch again costs ZERO downstream RPCs
+        out = f.cli.query_batch([{"op": "pi", "x": N}])
+        s2 = f.cli.stats()
+        assert out[0]["value"] == o_pi(N)
+        assert s2["batch_rpcs"] - s1["batch_rpcs"] == 0
+
+
+def test_router_batch_shard_down_members_tagged(ledger_dir, tmp_path):
+    with _Fabric(ledger_dir, tmp_path, shard1_dead=True,
+                 router_settings=RouterSettings(
+                     quiet=True, rounds=1, probe_timeout_s=1.0)) as f:
+        out = f.cli.query_batch([
+            {"op": "count", "lo": 10_000, "hi": 20_000},  # shard 0: fine
+            {"op": "count", "lo": f.E + 10, "hi": N},     # shard 1: dead
+            {"op": "pi", "x": N},                         # touches both
+        ])
+        assert out[0]["ok"] and out[0]["value"] == o_count(10_000, 20_000)
+        assert out[1]["ok"] is False
+        assert out[1]["error"] == "unavailable"
+        assert out[1]["shard"] == 1
+        assert out[2]["ok"] is False and out[2]["shard"] == 1
+        assert f.cli.stats()["shard_errors"] >= 1
+
+
+def test_router_rejects_malformed_batch_members_typed(ledger_dir,
+                                                      tmp_path):
+    with _Fabric(ledger_dir, tmp_path) as f:
+        out = f.cli.query_batch([
+            {"op": "pi", "x": 100},
+            {"op": "nth_prime", "k": 1},
+            {"op": "count", "lo": 2, "hi": 100, "kind": "twins"},
+        ])
+        assert out[0]["value"] == o_pi(100)
+        assert out[1]["error"] == "bad_request"
+        assert out[2]["error"] == "bad_request"
+        r = f.cli.query("batch", items="nope")
+        assert r["error"] == "bad_request"
+
+
+# --- static parity + bench gates ---------------------------------------------
+
+
+def test_wire_surface_parity_gate():
+    from tools.check_wire_ops import check
+    assert check() == []
+
+
+def test_bench_compare_gates_qps_regressions():
+    from tools.bench_compare import compare
+
+    def rec(v):
+        return {"service_hot_qps": {
+            "metric": "service_hot_qps", "value": v, "unit": "qps"}}
+
+    _lines, regressions = compare(rec(50_000.0), rec(40_000.0), 0.10)
+    assert regressions and "service_hot_qps" in regressions[0]
+    _lines, regressions = compare(rec(50_000.0), rec(48_000.0), 0.10)
+    assert regressions == []
+    _lines, regressions = compare(rec(50_000.0), rec(65_000.0), 0.10)
+    assert regressions == []

@@ -7,8 +7,9 @@ one JSON metric line per benchmark:
 ``{"metric": ..., "value": ..., "unit": "values/s/chip", ...}``), and
 the last metric re-parsed under ``parsed``. This tool pairs the two
 newest rounds by metric name and prints the delta for each; it exits
-nonzero when any throughput metric (``unit == "values/s/chip"``)
-regressed by more than ``--threshold`` (default 10%), when any latency
+nonzero when any throughput metric (``unit == "values/s/chip"``, or
+``unit == "qps"`` for request throughput — ISSUE 14) regressed by more
+than ``--threshold`` (default 10%), when any latency
 metric (``unit == "ms_p95"``) *increased* by more than the same
 threshold (lower is better — the service p95 gate, ISSUE 9), when any
 ``unit == "overhead_ratio"`` metric exceeds the ABSOLUTE 1.05 ceiling
@@ -117,6 +118,13 @@ def compare(
             verdict = f"  REGRESSION (> {threshold:.0%} drop)"
             regressions.append(
                 f"{name}: {ov:.4g} -> {nv:.4g} ({delta:+.1%})"
+            )
+        elif unit == "qps" and delta < -threshold:
+            # request throughput (ISSUE 14): higher is better, gate on
+            # drops — the service_hot_qps line rides this rule
+            verdict = f"  REGRESSION (> {threshold:.0%} throughput drop)"
+            regressions.append(
+                f"{name}: {ov:.4g} qps -> {nv:.4g} qps ({delta:+.1%})"
             )
         elif unit == "ms_p95" and delta > threshold:
             # latency: lower is better, gate on increases

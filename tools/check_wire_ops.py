@@ -1,0 +1,101 @@
+"""Static check: the server and router wire surfaces cannot drift
+(ISSUE 14 satellite).
+
+The router fronts the exact wire protocol the single server speaks —
+that is its core contract (ISSUE 11) — but nothing used to enforce it:
+a wire op added to ``sieve/service/server.py`` and forgotten in
+``sieve/service/router.py`` would silently bounce with
+``bad_request: unknown op`` only at runtime, behind a fleet. This tool
+regex-harvests every literal op (``op == "..."``) and message type
+(``mtype == "..."``) each dispatcher handles and asserts:
+
+* every server query op is routed (and vice versa — the router must
+  not invent ops the server cannot answer);
+* every server message type is either routed or explicitly listed in
+  ``router.UNROUTED_TYPES`` (typed-rejected, with the reason written
+  next to the constant);
+* the ``batch`` op (ISSUE 14) appears on BOTH sides.
+
+Importable (``from tools.check_wire_ops import check``) so the tier-1
+suite runs it; ``main`` prints the verdict for CI / hook use.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sieve.service import router as _router_mod  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVER_PY = os.path.join(_REPO, "sieve", "service", "server.py")
+ROUTER_PY = os.path.join(_REPO, "sieve", "service", "router.py")
+
+# literal comparisons in the dispatchers; != catches the
+# `if mtype != "query"` fall-through style
+_OP_RE = re.compile(r'\bop\s*(?:==|!=)\s*"(\w+)"')
+_MTYPE_RE = re.compile(r'\bmtype\s*(?:==|!=)\s*"(\w+)"')
+
+
+def harvest(path: str) -> tuple[set[str], set[str]]:
+    """(query ops, message types) a dispatcher source handles."""
+    with open(path) as f:
+        src = f.read()
+    return set(_OP_RE.findall(src)), set(_MTYPE_RE.findall(src))
+
+
+def check() -> list[str]:
+    """Every wire-surface drift found; empty list means parity holds."""
+    server_ops, server_types = harvest(SERVER_PY)
+    router_ops, router_types = harvest(ROUTER_PY)
+    unrouted = set(getattr(_router_mod, "UNROUTED_TYPES", ()))
+    problems: list[str] = []
+    for op in sorted(server_ops - router_ops):
+        problems.append(
+            f"server op {op!r} is not handled by the router "
+            "(add it to SieveRouter._execute or reject it explicitly)"
+        )
+    for op in sorted(router_ops - server_ops):
+        problems.append(
+            f"router op {op!r} has no server-side handler "
+            "(SieveService._execute does not know it)"
+        )
+    for t in sorted(server_types - router_types - unrouted):
+        problems.append(
+            f"server message type {t!r} is neither routed nor listed "
+            "in router.UNROUTED_TYPES"
+        )
+    for t in sorted(unrouted & router_types):
+        problems.append(
+            f"message type {t!r} is in router.UNROUTED_TYPES but the "
+            "router handles it — stale entry"
+        )
+    for side, ops in (("server", server_ops), ("router", router_ops)):
+        if "batch" not in ops:
+            problems.append(
+                f"the batch op (ISSUE 14) is missing from the {side}"
+            )
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    problems = check()
+    for p in problems:
+        print(f"check_wire_ops: {p}", file=sys.stderr)
+    if problems:
+        print(f"check_wire_ops: FAILED ({len(problems)} drift(s))",
+              file=sys.stderr)
+        return 1
+    server_ops, server_types = harvest(SERVER_PY)
+    print(
+        f"check_wire_ops: ok ({len(server_ops)} ops, "
+        f"{len(server_types)} message types in parity)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

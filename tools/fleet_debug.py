@@ -29,14 +29,27 @@ from typing import Any
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from sieve.debug import FLEET_BUNDLE_VERSION  # noqa: E402
-from sieve.service.client import ServiceClient  # noqa: E402
+from sieve.service.client import ClientPool, ServiceClient  # noqa: E402
 
 FLEET_BUNDLE_FILE = "fleet_bundle.json"
 
 
-def _pull(addr: str, timeout_s: float) -> dict[str, Any]:
-    """One endpoint's health + inline debug bundle, or a named error."""
+def _pull(addr: str, timeout_s: float,
+          pool: ClientPool | None = None) -> dict[str, Any]:
+    """One endpoint's health + inline debug bundle, or a named error.
+
+    With a ``pool`` (ISSUE 14) the endpoint's pipelined connection is
+    reused across calls; a transport failure invalidates just that
+    entry (counted in ``pool.reconnects`` on the next pull)."""
     try:
+        if pool is not None:
+            cli = pool.get(addr)
+            return {
+                "addr": addr,
+                "health": cli.health(),
+                "bundle": cli.debug(),
+                "error": None,
+            }
         with ServiceClient(addr, timeout_s=timeout_s) as cli:
             return {
                 "addr": addr,
@@ -45,23 +58,28 @@ def _pull(addr: str, timeout_s: float) -> dict[str, Any]:
                 "error": None,
             }
     except Exception as e:  # noqa: BLE001 — a dead process is a gap row
+        if pool is not None:
+            pool.invalidate(addr)
         return {"addr": addr, "health": None, "bundle": None,
                 "error": f"{type(e).__name__}: {e}"}
 
 
-def collect(router_addr: str, timeout_s: float = 10.0) -> dict:
+def collect(router_addr: str, timeout_s: float = 10.0,
+            pool: ClientPool | None = None) -> dict:
     """One merged fleet bundle (pure data; writing is separate).
 
     The router's health reply advertises every shard replica address;
     each is pulled for its own inline bundle and tagged with its shard
-    index. ``processes`` counts how many actually handed one over."""
-    router = _pull(router_addr, timeout_s)
+    index. ``processes`` counts how many actually handed one over.
+    Pass one :class:`ClientPool` across repeated collections to reuse
+    every endpoint's connection."""
+    router = _pull(router_addr, timeout_s, pool)
     replicas: list[dict[str, Any]] = []
     h = router["health"]
     if h is not None:
         for ent in h.get("shards", []):
             for addr in ent.get("addrs", []):
-                rep = _pull(addr, timeout_s)
+                rep = _pull(addr, timeout_s, pool)
                 rep["shard"] = ent.get("shard")
                 replicas.append(rep)
     processes = sum(
@@ -88,7 +106,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--timeout", type=float, default=10.0,
                    help="per-endpoint RPC timeout")
     args = p.parse_args(argv)
-    fleet = collect(args.router_addr, timeout_s=args.timeout)
+    # one pipelined client per endpoint for the whole collection
+    # (ISSUE 14): the router is pulled once for its bundle and again
+    # implicitly via health; both ride the same connection
+    with ClientPool(timeout_s=args.timeout) as pool:
+        fleet = collect(args.router_addr, timeout_s=args.timeout,
+                        pool=pool)
     out = args.out or f"fleet-debug-{time.strftime('%Y%m%d-%H%M%S')}"
     os.makedirs(out, exist_ok=True)
     path = os.path.join(out, FLEET_BUNDLE_FILE)

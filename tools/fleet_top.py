@@ -33,14 +33,30 @@ from typing import Any
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from sieve.service.client import ServiceClient  # noqa: E402
+from sieve.service.client import ClientPool, ServiceClient  # noqa: E402
 
 _CLEAR = "\x1b[2J\x1b[H"
 
 
-def _poll(addr: str, timeout_s: float) -> dict[str, Any]:
-    """health + stats + metrics of one endpoint, or a named error."""
+def _poll(addr: str, timeout_s: float,
+          pool: ClientPool | None = None) -> dict[str, Any]:
+    """health + stats + metrics of one endpoint, or a named error.
+
+    With a ``pool`` (ISSUE 14) the endpoint's pipelined connection is
+    reused across refresh cycles — one TCP connect per target for the
+    whole watch session instead of one per poll — and a transport
+    failure invalidates just that entry so the next cycle reconnects
+    (counted in ``pool.reconnects``)."""
     try:
+        if pool is not None:
+            cli = pool.get(addr)
+            return {
+                "addr": addr,
+                "health": cli.health(),
+                "stats": cli.stats(),
+                "metrics": cli.metrics(),
+                "error": None,
+            }
         with ServiceClient(addr, timeout_s=timeout_s) as cli:
             return {
                 "addr": addr,
@@ -50,17 +66,21 @@ def _poll(addr: str, timeout_s: float) -> dict[str, Any]:
                 "error": None,
             }
     except Exception as e:  # noqa: BLE001 — a dead replica is a table row
+        if pool is not None:
+            pool.invalidate(addr)
         return {"addr": addr, "health": None, "stats": None,
                 "metrics": None, "error": f"{type(e).__name__}: {e}"}
 
 
-def fleet_snapshot(router_addr: str, timeout_s: float = 5.0) -> dict:
+def fleet_snapshot(router_addr: str, timeout_s: float = 5.0,
+                   pool: ClientPool | None = None) -> dict:
     """One poll of the whole fleet (pure data; rendering is separate).
 
     Returns ``{"ts": epoch_s, "router": {...}, "shards": [...]}`` where
     each shard entry carries the router's view (range, status) plus a
-    polled row per replica address."""
-    router = _poll(router_addr, timeout_s)
+    polled row per replica address. Pass one :class:`ClientPool` across
+    consecutive calls to reuse every endpoint's connection."""
+    router = _poll(router_addr, timeout_s, pool)
     shards: list[dict[str, Any]] = []
     h = router["health"]
     if h is not None:
@@ -71,7 +91,7 @@ def fleet_snapshot(router_addr: str, timeout_s: float = 5.0) -> dict:
                 "hi": ent.get("hi"),
                 "status": ent.get("status"),
                 "replicas": [
-                    _poll(a, timeout_s) for a in ent.get("addrs", [])
+                    _poll(a, timeout_s, pool) for a in ent.get("addrs", [])
                 ],
             })
     return {"ts": time.time(), "router": router, "shards": shards}
@@ -230,20 +250,27 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(snap))
         return 0 if fleet_ok(snap) else 1
     prev: dict | None = None
-    try:
-        while True:
-            snap = fleet_snapshot(args.router_addr, timeout_s=args.timeout)
-            frame = render(snap, prev)
-            if args.once:
-                print(frame)
-                return 0 if snap["router"]["health"] is not None else 1
-            print(f"{_CLEAR}{time.strftime('%H:%M:%S')}  "
-                  f"(every {args.interval:g}s, ctrl-C to quit)")
-            print(frame, flush=True)
-            prev = snap
-            time.sleep(args.interval)
-    except KeyboardInterrupt:
-        return 0
+    # one pipelined client per endpoint, reused across refresh cycles
+    # (ISSUE 14): a watch session costs one connect per target, not one
+    # per poll; reconnects are counted and shown in the header
+    with ClientPool(timeout_s=args.timeout) as pool:
+        try:
+            while True:
+                snap = fleet_snapshot(args.router_addr,
+                                      timeout_s=args.timeout, pool=pool)
+                frame = render(snap, prev)
+                if args.once:
+                    print(frame)
+                    return 0 if snap["router"]["health"] is not None else 1
+                print(f"{_CLEAR}{time.strftime('%H:%M:%S')}  "
+                      f"(every {args.interval:g}s, ctrl-C to quit)  "
+                      f"[conns={pool.connects} "
+                      f"reconnects={pool.reconnects}]")
+                print(frame, flush=True)
+                prev = snap
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
 
 
 if __name__ == "__main__":
