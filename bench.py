@@ -122,7 +122,9 @@ def shallow_metric() -> None:
 def depth_metric() -> None:
     import jax
 
-    if jax.devices()[0].platform != "tpu" and not os.environ.get(
+    from sieve import env
+
+    if jax.devices()[0].platform != "tpu" and not env.env_str(
         "SIEVE_BENCH_DEPTH"
     ):
         print(
@@ -949,6 +951,129 @@ def service_recorder_overhead_metric() -> None:
     )
 
 
+def service_lock_debug_overhead_metric() -> None:
+    """Lock-sanitizer overhead (ISSUE 15): the same interleaved
+    off/on, fresh-service-per-pass, untimed-warmup, client-side,
+    min-across-reps p95 methodology as the trace/recorder overhead
+    lines, with ``SIEVE_LOCK_DEBUG`` as the variable. The flag is read
+    once at lock *construction* (``sieve/analysis/lockdebug.py``), so
+    the off pass prices the production default — plain ``threading``
+    primitives, zero wrapper code on the hot path — and the on pass
+    prices the recording wrappers (a thread-local stack walk plus a
+    pair-dict fold under the recorder mutex on every acquisition,
+    across every named lock in service, client, index, and metrics).
+    The workload is the same mixed line the other two overhead
+    metrics time — hot prefix counts, windowed counts, genuinely cold
+    chunks — so the three ratios stay comparable. A hot ``pi`` does
+    ~50 recorded acquisitions; the wrappers' cost lands inside those
+    critical sections, so contention amplifies it at p95. The on
+    passes end by asserting the observed orders against
+    ``CANONICAL_LOCK_ORDER`` — the bench run doubles as a sanitizer
+    smoke. Budget: 1.10 (the other overhead lines get 1.05; this one
+    wraps every lock in the plane and is a debug mode, not an
+    always-on tax)."""
+    import tempfile
+
+    import numpy as np
+
+    from sieve.analysis import lockdebug
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+    from sieve.seed import seed_primes
+    from sieve.service import ServiceClient, ServiceSettings, SieveService
+
+    n = 2_000_000
+    chunk = 1 << 18
+    reps = 25
+    oracle = seed_primes(n + 9 * chunk)
+
+    def o_pi(x: int) -> int:
+        return int(np.searchsorted(oracle, x, side="right"))
+
+    def workload(cli: ServiceClient, timings: list[float]) -> None:
+        def timed(fn, *a):
+            t0 = time.perf_counter()
+            out = fn(*a)
+            timings.append((time.perf_counter() - t0) * 1e3)
+            return out
+
+        for i in range(150):  # hot: prefix counts
+            x = (7919 * (i + 1)) % n
+            assert timed(cli.pi, x) == o_pi(x), f"pi({x}) parity failure"
+        for i in range(50):   # hot: windowed counts (materialize tier)
+            lo = (104_729 * (i + 1)) % (n - 60_000)
+            want = o_pi(lo + 50_000 - 1) - o_pi(lo - 1)
+            assert timed(cli.count, lo, lo + 50_000) == want, \
+                f"count({lo}) parity failure"
+        for i in range(8):    # cold: one fresh chunk each, batched
+            x = n + (i + 1) * chunk - 1
+            assert timed(cli.pi, x) == o_pi(x), f"cold pi({x}) parity"
+
+    with tempfile.TemporaryDirectory(prefix="sieve_bench_lockdbg") as ck:
+        cfg = SieveConfig(
+            n=n, backend="cpu-numpy", packing="odds", n_segments=8,
+            checkpoint_dir=ck, quiet=True,
+        )
+        run_local(cfg)
+
+        def run_pass(debug: bool) -> list[float]:
+            # construction-time flag: set before the service (and the
+            # client pool) build their locks, restore after
+            prev = os.environ.pop("SIEVE_LOCK_DEBUG", None)
+            if debug:
+                os.environ["SIEVE_LOCK_DEBUG"] = "1"
+                lockdebug.recorder().reset()
+            try:
+                settings = ServiceSettings(
+                    workers=4, queue_limit=64, cold_chunk=chunk,
+                    refresh_s=0.0,
+                )
+                with SieveService(cfg, settings) as svc, \
+                        ServiceClient(svc.addr, timeout_s=60) as cli:
+                    timings: list[float] = []
+                    for i in range(30):  # untimed warmup
+                        cli.pi((101 * (i + 1)) % n)
+                    workload(cli, timings)
+            finally:
+                if prev is None:
+                    os.environ.pop("SIEVE_LOCK_DEBUG", None)
+                else:
+                    os.environ["SIEVE_LOCK_DEBUG"] = prev
+            if debug:
+                problems = lockdebug.check_static_consistency()
+                assert not problems, \
+                    "lock sanitizer vs static graph: " + "; ".join(problems)
+            return timings
+
+        p95s_off: list[float] = []
+        p95s_on: list[float] = []
+        n_reqs = 0
+        for _ in range(reps):
+            off = run_pass(debug=False)
+            on = run_pass(debug=True)
+            p95s_off.append(_pctile(off, 0.95))
+            p95s_on.append(_pctile(on, 0.95))
+            n_reqs = len(on)
+    p95_off = min(p95s_off)
+    p95_on = min(p95s_on)
+    ratio = p95_on / p95_off if p95_off else float("inf")
+    budget = 1.10
+    print(
+        json.dumps(
+            {
+                "metric": "service_lock_debug_overhead_ratio",
+                "value": round(ratio, 4),
+                "unit": "overhead_ratio",
+                "vs_baseline": round(budget / ratio, 3) if ratio else None,
+                "p95_plain_ms": round(p95_off, 3),
+                "p95_debug_ms": round(p95_on, 3),
+                "n": n_reqs,
+                "reps": reps,
+            }
+        )
+    )
+
+
 def main() -> int:
     shallow_metric()
     depth_metric()
@@ -960,6 +1085,7 @@ def main() -> int:
     router_query_latency_metric()
     service_trace_overhead_metric()
     service_recorder_overhead_metric()
+    service_lock_debug_overhead_metric()
     return 0
 
 

@@ -1,0 +1,22 @@
+"""Concurrency analysis for the threaded service plane (ISSUE 15).
+
+Two halves that validate each other:
+
+* **Static** (:mod:`sieve.analysis.core`, :mod:`~sieve.analysis.checks`,
+  :mod:`~sieve.analysis.model`) — a stdlib-only (``ast``) pass over a
+  source tree that builds a call graph, walks thread roles out from
+  every ``threading.Thread`` creation site, extracts the lock-nesting
+  graph from ``with``-statements, and checks it against the committed
+  canonical lock order plus the ``# guard:`` shared-state annotations.
+  Driven by ``tools/check_concurrency.py`` with a ratcheting baseline.
+* **Dynamic** (:mod:`sieve.analysis.lockdebug`) — ``SIEVE_LOCK_DEBUG=1``
+  swaps the named service-plane locks for recording wrappers, so the
+  chaos/service smokes observe *real* acquisition orders and assert
+  them consistent with the static canonical order. With the flag off
+  the named constructors return plain ``threading`` primitives — the
+  default path costs nothing.
+
+This package is import-light on purpose: service modules import only
+``lockdebug`` (stdlib ``threading`` + ``os``); the ast machinery loads
+only inside the checker tools and tests.
+"""

@@ -1,0 +1,155 @@
+"""Repo-specific concurrency model: thread roles, the canonical lock
+order, and the blocking-call list for the event-loop rule (ISSUE 15).
+
+This module is *data*, not machinery — :mod:`sieve.analysis.checks`
+consumes a :class:`Model` and the default instance below describes the
+sieve service plane. Fixture tests build their own small Models.
+
+Canonical lock order
+--------------------
+
+``CANONICAL_LOCK_ORDER`` lists every lock in the package, outermost
+first: a thread may only acquire a lock whose index is *greater* than
+every lock it already holds. The order is derived from the acquisition
+edges the analyzer observes (``tools/check_concurrency.py --dump``
+prints them) and is cross-checked at runtime by
+:mod:`sieve.analysis.lockdebug` under ``SIEVE_LOCK_DEBUG=1``. Adding a
+lock means adding it here — an acquisition edge touching an unlisted
+lock is a finding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Model:
+    # allowed acquisition order, outermost first
+    canonical_lock_order: tuple[str, ...] = ()
+    # roles that run an event loop and must never block
+    loop_roles: frozenset[str] = frozenset()
+    # dotted external calls that block
+    blocking_calls: frozenset[str] = frozenset()
+    # resolved-target prefixes that block (module or Class. prefixes)
+    blocking_prefixes: tuple[str, ...] = ()
+    # bare attribute-call names that block regardless of receiver type
+    blocking_attrs: frozenset[str] = frozenset({"wait"})
+    # class names whose public methods seed the synthetic "app" role
+    # (the application thread calling the public API)
+    app_role_classes: frozenset[str] = frozenset()
+    # extra (qualname, role) seeds
+    extra_seeds: tuple[tuple[str, str], ...] = ()
+
+
+# Locks outermost-first. Derived from the observed acquisition edges
+# (``tools/check_concurrency.py --dump``); the runtime sanitizer
+# asserts real executions agree. Within a tier the order is alphabetic
+# convention — no edge exists yet — but once committed it is law: a new
+# nesting that contradicts it is a finding, not a reason to reshuffle.
+CANONICAL_LOCK_ORDER: tuple[str, ...] = (
+    # -- cluster / client coordination (outermost: these call into
+    #    everything below while held only in stop/teardown paths)
+    "_Cluster.lock",
+    "_Cluster.tele_lock",
+    "ClientPool._lock",
+    "ReplicaSet._lock",
+    "_Replica.lock",
+    # -- service plane outer tier: queue admission, refresh, dispatch
+    "LedgerFollower._poll_lock",
+    "SieveService._lane_cond",
+    "SieveService._cold_lock",
+    "SieveService._slo_lock",
+    "SieveService._seq_lock",
+    "SieveService._inflight_lock",
+    "SieveService._conns_lock",
+    "SieveService._stats_lock",
+    # -- router tier
+    "SieveRouter._totals_lock",
+    "SieveRouter._down_lock",
+    "SieveRouter._tele_lock",
+    "SieveRouter._seq_lock",
+    "SieveRouter._inflight_lock",
+    "SieveRouter._conns_lock",
+    "SieveRouter._stats_lock",
+    # -- per-connection write path: tx (the wire) strictly outside
+    #    lock (the queue) — _flush holds tx across queue inspections
+    "_Conn.tx",
+    "_Conn.lock",
+    # -- cold backend: dispatch serialization, then breaker state
+    "ColdBackend._lock",
+    "ColdBackend._state_lock",
+    # -- index tier
+    "SieveIndex._stat_lock",
+    "BitsetLRU._lock",
+    # -- leaf infrastructure (innermost: never call out while held)
+    "ChaosSchedule._lock",
+    "FlightRecorder._lock",
+    "MetricsHistory._lock",
+    "MetricsRegistry._lock",
+    "Counter._lock",
+    "Gauge._lock",
+    "Histogram._lock",
+    "metrics._SINKS_LOCK",
+    "MemorySink._lock",
+    "StreamSink._lock",
+    "PrepPipeline._cond",
+    "Tracer._lock",
+    "seed._cache_lock",
+    # lockdebug's own pair-set mutex: the sanitizer records
+    # while the recorded lock is already held, so it is the
+    # global innermost lock by construction
+    "_Recorder._mu",
+)
+
+
+#: Thread roles that run a selectors-based event loop: nothing
+#: reachable from these may block (no waits, sleeps, ledger I/O, rpc
+#: sends, or backend dispatch).
+LOOP_ROLES = frozenset({"svc-wire", "router-accept"})
+
+BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "socket.create_connection",
+    "subprocess.run",
+    "subprocess.check_call",
+    "subprocess.check_output",
+})
+
+BLOCKING_PREFIXES = (
+    # framed send/recv block on the socket (FrameDecoder/encode_msg are
+    # pure CPU and deliberately not listed)
+    "sieve.rpc:send_msg",
+    "sieve.rpc:recv_msg",
+    "sieve.rpc:_recv_exact",
+    "sieve.checkpoint:",   # ledger I/O (fsync)
+    "sieve.service.server:ColdBackend.",   # backend dispatch
+    "sieve.service.server:ColdBatcher.submit",  # waits on a flight
+)
+
+APP_ROLE_CLASSES = frozenset({
+    "SieveService",
+    "SieveRouter",
+    "ServiceClient",
+    "ClientPool",
+    "ReplicaSet",
+    "ColdBackend",
+})
+
+
+def default_model() -> Model:
+    return Model(
+        canonical_lock_order=CANONICAL_LOCK_ORDER,
+        loop_roles=LOOP_ROLES,
+        blocking_calls=BLOCKING_CALLS,
+        blocking_prefixes=BLOCKING_PREFIXES,
+        app_role_classes=APP_ROLE_CLASSES,
+    )
+
+
+#: Known constructor-like helpers: call target -> class fullid, so the
+#: scanner can type ``tr = trace.get_tracer()`` receivers.
+RETURN_TYPES = {
+    "sieve.trace:get_tracer": "sieve.trace:Tracer",
+    "sieve.metrics:registry": "sieve.metrics:MetricsRegistry",
+}

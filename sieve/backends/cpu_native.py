@@ -18,7 +18,7 @@ from pathlib import Path
 
 import numpy as np
 
-from sieve import trace
+from sieve import env, trace
 from sieve.bitset import get_layout
 from sieve.worker import SegmentResult, SieveWorker
 
@@ -30,7 +30,7 @@ def _build_and_load() -> ctypes.CDLL:
     global _LIB
     if _LIB is not None:
         return _LIB
-    name = "libmark_asan.so" if os.environ.get("SIEVE_NATIVE_ASAN") else "libmark.so"
+    name = "libmark_asan.so" if env.env_str("SIEVE_NATIVE_ASAN") else "libmark.so"
     so = _CSRC / "build" / name
     src = _CSRC / "mark_multiples.cc"
     if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:

@@ -18,7 +18,7 @@ import time
 
 import numpy as np
 
-from sieve import trace
+from sieve import env, trace
 from sieve.backends.cpu_numpy import CpuNumpyWorker
 from sieve.bitset import get_layout
 from sieve.kernels.jax_mark import (
@@ -75,7 +75,7 @@ class JaxWorker(SieveWorker):
         self._jax = jax
         # SIEVE_JAX_PLATFORM pins the device platform (tests use "cpu" so CI
         # never depends on — or occupies — the real TPU).
-        platform = os.environ.get("SIEVE_JAX_PLATFORM")
+        platform = env.env_str("SIEVE_JAX_PLATFORM")
         self._device = jax.devices(platform)[0] if platform else None
         self._cpu_fallback = CpuNumpyWorker(config)
         self._chain: TieredChain | None = None

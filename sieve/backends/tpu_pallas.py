@@ -24,7 +24,7 @@ import time
 
 import numpy as np
 
-from sieve import trace
+from sieve import env, trace
 from sieve.backends.cpu_numpy import CpuNumpyWorker
 from sieve.backends.jax_backend import MIN_DEVICE_BITS, pair_kind
 from sieve.bitset import get_layout
@@ -45,7 +45,7 @@ class PallasWorker(SieveWorker):
         import jax
 
         self._jax = jax
-        platform = os.environ.get("SIEVE_JAX_PLATFORM")
+        platform = env.env_str("SIEVE_JAX_PLATFORM")
         self._device = jax.devices(platform)[0] if platform else jax.devices()[0]
         self._interpret = self._device.platform == "cpu"
         self._cpu_fallback = CpuNumpyWorker(config)

@@ -149,6 +149,8 @@ from __future__ import annotations
 import dataclasses
 import threading
 
+from sieve.analysis.lockdebug import named_lock
+
 ANY_WORKER = -1  # "any@sK": whichever worker draws segment K
 KINDS = (
     "kill",
@@ -337,8 +339,8 @@ class ChaosSchedule:
     """
 
     def __init__(self, directives: list[ChaosDirective]):
-        self._lock = threading.Lock()
-        self._pending = list(directives)
+        self._lock = named_lock("ChaosSchedule._lock")
+        self._pending = list(directives)  # guard: _lock
 
     def __len__(self) -> int:
         with self._lock:

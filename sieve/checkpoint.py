@@ -67,6 +67,7 @@ import tempfile
 from pathlib import Path
 from typing import TYPE_CHECKING
 
+from sieve import env
 from sieve.worker import SegmentResult
 
 if TYPE_CHECKING:
@@ -103,7 +104,7 @@ def _payload_checksum(config_hash: str, completed: dict[str, dict]) -> str:
 
 
 def _fsync_enabled() -> bool:
-    return os.environ.get("SIEVE_LEDGER_FSYNC", "1") != "0"
+    return env.env_str("SIEVE_LEDGER_FSYNC", "1") != "0"
 
 
 def ledger_fingerprint(path: Path | str) -> tuple[int, int] | None:

@@ -72,9 +72,12 @@ import sys
 import threading
 import time
 
+from sieve import env
+
 import numpy as np
 
 from sieve import trace
+from sieve.analysis.lockdebug import named_lock
 from sieve.chaos import ANY_WORKER, ChaosSchedule
 from sieve.checkpoint import Ledger
 from sieve.config import SieveConfig
@@ -89,20 +92,20 @@ from sieve.worker import SegmentResult
 HEARTBEAT_S = 1.0
 # import-time snapshot kept for backwards compatibility; the live floor
 # is _base_deadline_s(), re-read per call so runs/tests can tune it
-DEADLINE_S = float(os.environ.get("SIEVE_CLUSTER_DEADLINE_S", "60"))
+DEADLINE_S = env.env_float("SIEVE_CLUSTER_DEADLINE_S", 60.0)
 _HANDSHAKE_TIMEOUT_S = 30.0
 
 
 def _base_deadline_s() -> float:
     """Static silence-deadline floor (the pre-adaptive constant)."""
-    return float(os.environ.get("SIEVE_CLUSTER_DEADLINE_S", "60"))
+    return env.env_float("SIEVE_CLUSTER_DEADLINE_S", 60.0)
 
 
 def _worker_recv_timeout_s() -> float:
     """Worker-side bound on any single socket read: an idle worker whose
     coordinator went silent reconnects (or gives up) instead of blocking
     in recv forever."""
-    return float(os.environ.get("SIEVE_WORKER_RECV_TIMEOUT_S", "30"))
+    return env.env_float("SIEVE_WORKER_RECV_TIMEOUT_S", 30.0)
 
 
 # --- worker role -------------------------------------------------------------
@@ -124,11 +127,11 @@ def serve_worker(config: SieveConfig, worker_id: int | None = None) -> None:
     the worker in ``recv`` forever.
     """
     if worker_id is None:
-        worker_id = int(os.environ.get("SIEVE_WORKER_ID", "0"))
+        worker_id = env.env_int("SIEVE_WORKER_ID", 0)
     host, port = _parse_addr(config.coordinator_addr)
-    base = float(os.environ.get("SIEVE_WORKER_BACKOFF_S", "0.1"))
-    cap = float(os.environ.get("SIEVE_WORKER_BACKOFF_CAP_S", "5.0"))
-    max_tries = int(os.environ.get("SIEVE_WORKER_RECONNECT_MAX", "6"))
+    base = env.env_float("SIEVE_WORKER_BACKOFF_S", 0.1)
+    cap = env.env_float("SIEVE_WORKER_BACKOFF_CAP_S", 5.0)
+    max_tries = env.env_int("SIEVE_WORKER_RECONNECT_MAX", 6)
 
     from sieve.worker import telemetry_start
 
@@ -262,7 +265,7 @@ class _WorkerSession:
 
         def _work(m=msg, ctx=ctx):
             try:
-                if os.environ.get("SIEVE_CHAOS_RAISE") == str(m["seg_id"]):
+                if env.env_str("SIEVE_CHAOS_RAISE") == str(m["seg_id"]):
                     raise RuntimeError("chaos: injected segment failure")
                 with trace.span(
                     "worker.segment",
@@ -328,7 +331,7 @@ class _WorkerSession:
 
 def _worker_backend() -> str:
     """Compute backend used inside cluster workers: native if it builds."""
-    forced = os.environ.get("SIEVE_CLUSTER_WORKER_BACKEND")
+    forced = env.env_str("SIEVE_CLUSTER_WORKER_BACKEND")
     if forced:
         return forced
     try:
@@ -521,7 +524,7 @@ class _Cluster:
         self.ledger = ledger
         self.queue: queue.Queue = queue.Queue()
         self.done: dict[int, SegmentResult] = {}
-        self.lock = threading.Lock()
+        self.lock = named_lock("_Cluster.lock")
         self.n_expected = len(segments)
         self.all_done = threading.Event()
         self.attempts: dict[int, int] = {}
@@ -530,7 +533,7 @@ class _Cluster:
         # context; shipped telemetry and clock samples accumulate here per
         # worker until the end-of-run merge
         self.run_id = os.urandom(4).hex()
-        self.tele_lock = threading.Lock()
+        self.tele_lock = named_lock("_Cluster.tele_lock")
         self.telemetry: dict[int, list[dict]] = {}   # worker -> raw events
         self.worker_registry: dict[int, dict] = {}   # latest snapshot
         self.tele_dropped: dict[int, int] = {}       # cumulative per worker
@@ -597,8 +600,8 @@ class _Cluster:
         8× the worker's min-RTT (transport jitter). Operators lower the
         static floor for fast dead-worker detection; the live terms keep
         it safe."""
-        hb_miss = float(os.environ.get("SIEVE_CLUSTER_HB_MISS", "4"))
-        slack = float(os.environ.get("SIEVE_CLUSTER_DEADLINE_SLACK", "4"))
+        hb_miss = env.env_float("SIEVE_CLUSTER_HB_MISS", 4.0)
+        slack = env.env_float("SIEVE_CLUSTER_DEADLINE_SLACK", 4.0)
         with self.lock:
             samples = sorted(self._attempt_s)
         p95 = 0.0
@@ -859,10 +862,10 @@ def run_cluster(config: SieveConfig) -> SieveResult:
     server.settimeout(0.5)
 
     procs: list[subprocess.Popen] = []
-    if not cluster.all_done.is_set() and not os.environ.get("SIEVE_CLUSTER_NO_SPAWN"):
+    if not cluster.all_done.is_set() and not env.env_str("SIEVE_CLUSTER_NO_SPAWN"):
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         for i in range(eff.workers):
-            env = {**os.environ, "SIEVE_WORKER_ID": str(i)}
+            wenv = {**os.environ, "SIEVE_WORKER_ID": str(i)}
             procs.append(
                 subprocess.Popen(
                     [
@@ -874,7 +877,7 @@ def run_cluster(config: SieveConfig) -> SieveResult:
                     ]
                     + (["--twins"] if eff.twins else []),
                     cwd=repo_root,
-                    env=env,
+                    env=wenv,
                 )
             )
 
@@ -886,7 +889,7 @@ def run_cluster(config: SieveConfig) -> SieveResult:
         # measured numpy kernel floor of 1.3e8 — see BASELINE.md), added to
         # the fixed grace for spawn + handshake so tiny runs keep the old
         # behavior.
-        floor_vps = float(os.environ.get("SIEVE_CLUSTER_FLOOR_VPS", "1e6"))
+        floor_vps = env.env_float("SIEVE_CLUSTER_FLOOR_VPS", 1e6)
         workload_s = eff.n / (floor_vps * max(1, eff.workers))
         # a *duration* budget, not a wall-clock appointment: it rides the
         # monotonic trace clock like every other timestamp (a true wall

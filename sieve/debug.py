@@ -33,6 +33,7 @@ import time
 from typing import Any
 
 from sieve import metrics, trace
+from sieve.analysis.lockdebug import named_lock
 
 BUNDLE_VERSION = "sieve-debug/1"
 FLEET_BUNDLE_VERSION = "sieve-fleet-debug/1"
@@ -110,11 +111,11 @@ class FlightRecorder:
         self._logger = logger
         self._events: collections.deque = collections.deque(maxlen=event_tail)
         self._last_fire: dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("FlightRecorder._lock")
         self._installed = False
-        self._bundles = 0
-        self._suppressed = 0
-        self.last_bundle: dict | None = None
+        self._bundles = 0  # guard: _lock
+        self._suppressed = 0  # guard: _lock
+        self.last_bundle: dict | None = None  # guard: _lock
         self._sys_hook = None
         self._thread_hook = None
         self._prev_sys_hook = None

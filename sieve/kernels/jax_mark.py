@@ -36,6 +36,8 @@ from __future__ import annotations
 import functools
 import os
 
+from sieve import env
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -66,8 +68,8 @@ PAIR_SHIFT = {
 # TIER1_MAX 1024 -> ~190s compile; 256 -> 147s; 64 -> 5.6s with the best
 # runtime of the three (1.77e9 values/s) — the unrolled pattern ops were
 # nearly all compile cost, and the tier-2 scan handles m in (64, 1024] fine.
-TIER1_MAX = int(os.environ.get("SIEVE_TIER1_MAX", "64"))
-SPEC_BLOCK = int(os.environ.get("SIEVE_SPEC_BLOCK", "16"))
+TIER1_MAX = env.env_int("SIEVE_TIER1_MAX", 64)
+SPEC_BLOCK = env.env_int("SIEVE_SPEC_BLOCK", 16)
 WORD_BUCKET = 8192    # word-count padding granularity (jit cache bound)
 
 _U32 = jnp.uint32

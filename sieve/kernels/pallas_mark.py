@@ -69,6 +69,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from sieve import env
 from sieve.bitset import get_layout
 from sieve.kernels.specs import _pair_mask, flat_crossings, tier1_specs
 
@@ -84,7 +85,7 @@ def _load_tuned() -> dict:
     so a tuned file never overrides a deliberate env sweep."""
     import json
 
-    path = _os.environ.get("SIEVE_TUNED_JSON")
+    path = env.env_str("SIEVE_TUNED_JSON")
     if path is None:
         path = _os.path.join(
             _os.path.dirname(_os.path.dirname(_os.path.dirname(
@@ -103,10 +104,10 @@ _TUNED = _load_tuned()
 
 
 def _knob(name: str, default: int) -> int:
-    v = _os.environ.get(name)
+    v = env.env_int(name, None)
     if v is None:
-        v = _TUNED.get(name, default)
-    return int(v)
+        v = int(_TUNED.get(name, default))
+    return v
 
 
 # Microbenchmarked on TPU v5e. Pre-group-D (n=1e9): R=64 -> 424ms,
@@ -955,7 +956,7 @@ def pallas_fused_enabled() -> bool:
     """Fused in-kernel reduction is the default; SIEVE_PALLAS_FUSED=0
     selects the split kernel + XLA-postlude path (the parity oracle).
     Read per call so tests and dryruns can flip it."""
-    v = _os.environ.get("SIEVE_PALLAS_FUSED")
+    v = env.env_str("SIEVE_PALLAS_FUSED")
     if v is None:
         v = str(_TUNED.get("SIEVE_PALLAS_FUSED", "1"))
     return v != "0"

@@ -37,7 +37,8 @@ import threading
 import zlib
 from typing import TYPE_CHECKING, Any, TextIO
 
-from sieve import trace
+from sieve import env, trace
+from sieve.analysis.lockdebug import named_lock
 
 if TYPE_CHECKING:
     from sieve.config import SieveConfig
@@ -166,7 +167,7 @@ class Counter:
     def __init__(self, name: str):
         self.name = name
         self.value = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("Counter._lock")
 
     def inc(self, n: int = 1) -> None:
         with self._lock:
@@ -185,7 +186,7 @@ class Gauge:
     def __init__(self, name: str):
         self.name = name
         self.value: float | None = None
-        self._lock = threading.Lock()
+        self._lock = named_lock("Gauge._lock")
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -236,7 +237,7 @@ class Histogram:
         self._reservoir: list[float] = []
         self._cap = max(1, reservoir)
         self._rng = random.Random(zlib.crc32(name.encode()))
-        self._lock = threading.Lock()
+        self._lock = named_lock("Histogram._lock")
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -275,7 +276,7 @@ class MetricsRegistry:
     """Named instruments; one process-wide instance by default."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("MetricsRegistry._lock")
         self._instruments: dict[str, Any] = {}
 
     def _get(self, name: str, cls):
@@ -326,19 +327,10 @@ HISTORY_DECIMATE = 10
 def sample_interval_s() -> float:
     """The MetricsHistory tick from ``SIEVE_METRICS_SAMPLE_S`` (seconds;
     default 1.0; 0 disables sampling). Parse failures name the env var."""
-    raw = os.environ.get("SIEVE_METRICS_SAMPLE_S")
-    if raw is None:
-        return 1.0
-    try:
-        v = float(raw)
-    except ValueError:
-        raise ValueError(
-            f"env SIEVE_METRICS_SAMPLE_S={raw!r}: expected a number of "
-            "seconds (0 disables sampling)"
-        ) from None
+    v = env.env_float("SIEVE_METRICS_SAMPLE_S", 1.0)
     if v < 0 or not math.isfinite(v):
         raise ValueError(
-            f"env SIEVE_METRICS_SAMPLE_S={raw!r}: must be a non-negative "
+            f"env SIEVE_METRICS_SAMPLE_S={v!r}: must be a non-negative "
             "finite number of seconds"
         )
     return v
@@ -371,8 +363,8 @@ class MetricsHistory:
         self._recent: collections.deque = collections.deque(maxlen=recent)
         self._coarse: collections.deque = collections.deque(maxlen=coarse)
         self._decimate = max(1, decimate)
-        self._taken = 0
-        self._lock = threading.Lock()
+        self._taken = 0  # guard: _lock
+        self._lock = named_lock("MetricsHistory._lock")
         self._stop_evt = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -462,7 +454,7 @@ class MemorySink:
 
     def __init__(self) -> None:
         self.records: list[dict] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("MemorySink._lock")
 
     def emit(self, record: dict) -> None:
         with self._lock:
@@ -477,7 +469,7 @@ class StreamSink:
 
     def __init__(self, stream: TextIO):
         self.stream = stream
-        self._lock = threading.Lock()
+        self._lock = named_lock("StreamSink._lock")
 
     def emit(self, record: dict) -> None:
         with self._lock:
@@ -499,7 +491,7 @@ class FileSink(StreamSink):
 
 
 _SINKS: list = []
-_SINKS_LOCK = threading.Lock()
+_SINKS_LOCK = named_lock("metrics._SINKS_LOCK")
 
 
 def add_sink(sink) -> None:

@@ -29,7 +29,7 @@ import os
 
 import numpy as np
 
-from sieve import trace
+from sieve import env, trace
 from sieve.backends.jax_backend import pair_kind
 from sieve.bitset import get_layout
 from sieve.checkpoint import Ledger
@@ -78,7 +78,7 @@ def build_mesh(n_devices: int):
     multi-chip logic is exercisable on a single-chip host (SURVEY 4.2)."""
     import jax
 
-    platform = os.environ.get("SIEVE_JAX_PLATFORM")
+    platform = env.env_str("SIEVE_JAX_PLATFORM")
     devices = jax.devices(platform) if platform else jax.devices()
     if len(devices) < n_devices:
         try:
@@ -438,7 +438,7 @@ def run_mesh(config: SieveConfig, mesh=None) -> SieveResult:
     # `window` rounds late. Overlaps host prep/stacking and device->host
     # round trips (tunnel RTT ~70 ms) with device compute; checkpoint
     # granularity worsens by at most `window` rounds on failure.
-    window = max(0, int(os.environ.get("SIEVE_ROUND_WINDOW", "2")))
+    window = max(0, env.env_int("SIEVE_ROUND_WINDOW", 2))
     pending: list = []
 
     def _drain_one():

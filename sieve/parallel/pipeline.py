@@ -27,7 +27,8 @@ import os
 import threading
 from typing import Any, Callable, Sequence
 
-from sieve import trace
+from sieve import env, trace
+from sieve.analysis.lockdebug import named_condition
 
 
 class PrepPipeline:
@@ -53,11 +54,11 @@ class PrepPipeline:
         self._prep = prep_round
         self.capacity = max(1, window + 1)
         if threads is None:
-            threads = int(os.environ.get("SIEVE_PREP_THREADS", "0")) or min(
+            threads = env.env_int("SIEVE_PREP_THREADS", 0) or min(
                 self.capacity, 2
             )
         nthreads = max(1, min(threads, self.capacity, max(1, len(self.rounds))))
-        self._cond = threading.Condition()
+        self._cond = named_condition("PrepPipeline._cond")
         self._next = 0          # index into rounds of the next unclaimed round
         self._consumed = 0      # rounds handed back through take()
         self._ready: dict[int, Any] = {}

@@ -18,11 +18,13 @@ import collections
 import math
 import threading
 
+from sieve.analysis.lockdebug import named_lock
+
 import numpy as np
 
 _CACHE_SIZE = 8  # distinct limits kept (largest seed set ~628 KB at 1e7)
 _cache: "collections.OrderedDict[int, np.ndarray]" = collections.OrderedDict()
-_cache_lock = threading.Lock()
+_cache_lock = named_lock("seed._cache_lock")
 
 
 def seed_primes(limit: int) -> np.ndarray:

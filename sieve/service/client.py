@@ -61,6 +61,7 @@ import uuid
 from typing import Any, Sequence
 
 from sieve import trace
+from sieve.analysis.lockdebug import named_lock
 from sieve.metrics import registry
 from sieve.rpc import parse_addr, recv_msg, send_msg
 
@@ -276,7 +277,7 @@ class ClientPool:
         self.timeout_s = timeout_s
         self._clients: dict[str, ServiceClient] = {}
         self._ever: set[str] = set()
-        self._lock = threading.Lock()
+        self._lock = named_lock("ClientPool._lock")
         self.connects = 0
         self.reconnects = 0
 
@@ -337,7 +338,7 @@ class _Replica:
     def __init__(self, addr: str):
         self.addr = addr
         self.client: ServiceClient | None = None
-        self.lock = threading.Lock()
+        self.lock = named_lock("_Replica.lock")
         self.fails = 0
         self.open_until = 0.0
         # monotonic timestamp of the last successful health probe
@@ -380,7 +381,7 @@ class ReplicaSet:
         # adds a probe round-trip on the hot path yet still re-detects
         # draining replicas within one TTL.
         self.probe_ttl_s = probe_ttl_s
-        self._lock = threading.Lock()
+        self._lock = named_lock("ReplicaSet._lock")
         self._rr = 0
         self._run_id = uuid.uuid4().hex[:8]
         self._ctx_seq = itertools.count(1)

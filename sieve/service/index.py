@@ -33,6 +33,7 @@ from typing import Callable, Sequence
 import numpy as np
 
 from sieve import trace
+from sieve.analysis.lockdebug import named_lock
 from sieve.backends.cpu_numpy import sieve_segment_flags
 from sieve.bitset import get_layout
 from sieve.seed import seed_primes
@@ -81,7 +82,7 @@ class BitsetLRU:
 
     def __init__(self, capacity: int):
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = named_lock("BitsetLRU._lock")
         self._cache: "collections.OrderedDict[tuple[int, int], np.ndarray]" = (
             collections.OrderedDict()
         )
@@ -157,9 +158,9 @@ class SieveIndex:
         # content depends only on (packing, lo, hi), never on ledger
         # entries, so cached chunks are exact under any snapshot
         self.lru = lru if lru is not None else BitsetLRU(lru_segments)
-        self._stat_lock = threading.Lock()
-        self.lru_hits = 0
-        self.materialized = 0
+        self._stat_lock = named_lock("SieveIndex._stat_lock")
+        self.lru_hits = 0  # guard: _stat_lock
+        self.materialized = 0  # guard: _stat_lock
 
     # --- flags -----------------------------------------------------------
 

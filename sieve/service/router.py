@@ -81,6 +81,7 @@ from sieve.service.client import CallTimeout, ReplicaSet, ServiceError
 from sieve.service.server import BadRequest, DeadlineExceeded, Draining
 from sieve.service.shards import ShardMap
 from sieve import trace
+from sieve.analysis.lockdebug import named_lock
 
 _PAIR_GAP = {"twins": 2, "cousins": 4}
 
@@ -249,33 +250,36 @@ class SieveRouter:
         self.chaos = ChaosSchedule(parse_chaos(chaos_spec))
         # cumulative-totals cache: _totals[i] = primes in shard i's full
         # declared range — an immutable fact, cached forever once known
-        self._totals: dict[int, int] = {}
-        self._totals_lock = threading.Lock()
+        self._totals: dict[int, int] = {}  # guard: _totals_lock
+        self._totals_lock = named_lock("SieveRouter._totals_lock")
         # svc_shard_down windows: shard index -> monotonic expiry
-        self._down_until: dict[int, float] = {}
-        self._down_lock = threading.Lock()
+        self._down_until: dict[int, float] = {}  # guard: _down_lock
+        self._down_lock = named_lock("SieveRouter._down_lock")
         # fleet tracing (ISSUE 12): trace-ctx run id for requests that
         # arrive unstamped, per-replica clock aligners keyed by address,
         # and the synthetic pid each replica's merged track renders under
         self._run_id = uuid.uuid4().hex[:8]
-        self._tele_lock = threading.Lock()
-        self._aligns: dict[str, trace.ClockAlign] = {}
-        self._replica_pids: dict[str, int] = {}
-        self._replica_shard: dict[str, int] = {}
-        self._replica_named: set[str] = set()
-        self._stats = {k: 0 for k in _ROUTER_STATS}
-        self._stats_lock = threading.Lock()
-        self._seq = 0
-        self._seq_lock = threading.Lock()
+        self._tele_lock = named_lock("SieveRouter._tele_lock")
+        self._aligns: dict[str, trace.ClockAlign] = {}  # guard: _tele_lock
+        self._replica_pids: dict[str, int] = {}  # guard: _tele_lock
+        self._replica_shard: dict[str, int] = {}  # guard: _tele_lock
+        self._replica_named: set[str] = set()  # guard: _tele_lock
+        self._stats = {k: 0 for k in _ROUTER_STATS}  # guard: _stats_lock
+        self._stats_lock = named_lock("SieveRouter._stats_lock")
+        self._seq = 0  # guard: _seq_lock
+        self._seq_lock = named_lock("SieveRouter._seq_lock")
         self._threads: list[threading.Thread] = []
-        self._conns: set[socket.socket] = set()
-        self._conns_lock = threading.Lock()
-        self._listener: socket.socket | None = None
+        self._conns: set[socket.socket] = set()  # guard: _conns_lock
+        self._conns_lock = named_lock("SieveRouter._conns_lock")
+        self._listener: socket.socket | None = None  # guard: none(set
+        # once in start() before the accept thread exists)
         self._bound_addr: str | None = None
-        self._closing = False
-        self._draining = False
-        self._inflight_n = 0
-        self._inflight_lock = threading.Lock()
+        self._closing = False  # guard: none(monotonic stop flag;
+        # bool reads are GIL-atomic)
+        self._draining = False  # guard: none(monotonic drain flag;
+        # a racy reader sheds at most one extra request)
+        self._inflight_n = 0  # guard: _inflight_lock
+        self._inflight_lock = named_lock("SieveRouter._inflight_lock")
         self.drain_event = threading.Event()
         self._drained = threading.Event()
         # flight recorder (ISSUE 13): armed in start(); router_shard_down
@@ -332,7 +336,9 @@ class SieveRouter:
                 self._listener.close()
             except OSError:
                 pass
-        self.metrics.event("router_drain", inflight=self._inflight_n)
+        with self._inflight_lock:
+            inflight = self._inflight_n
+        self.metrics.event("router_drain", inflight=inflight)
         self.drain_event.set()
         self._maybe_drained()
 
@@ -1065,7 +1071,8 @@ class SieveRouter:
         out["shard_count"] = len(self.map)
         out["range_lo"] = self.map.lo
         out["range_hi"] = self.map.hi
-        out["totals_cached"] = len(self._totals)
+        with self._totals_lock:
+            out["totals_cached"] = len(self._totals)
         out["draining"] = self._draining
         out["probes"] = sum(rs.probes for rs in self.sets)
         out["failovers"] = sum(rs.failovers for rs in self.sets)

@@ -103,7 +103,8 @@ from typing import TYPE_CHECKING, Any
 
 import numpy as np
 
-from sieve import trace
+from sieve import env, trace
+from sieve.analysis.lockdebug import named_condition, named_lock
 from sieve.backends import make_worker
 from sieve.chaos import (
     SERVICE_REQUEST_KINDS,
@@ -178,28 +179,15 @@ _ERROR_KIND = {
 }
 
 
-def _env_int(name: str, default: int | None) -> int | None:
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        raise ValueError(
-            f"env {name}={raw!r}: expected an integer"
-        ) from None
+# validated knob readers live in sieve/env.py (ISSUE 15) so every
+# plane shares one parse-failure contract; the local names survive
+# because the service plane reads them pervasively
+_env_int = env.env_int
+_env_float = env.env_float
 
 
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        raise ValueError(
-            f"env {name}={raw!r}: expected a number"
-        ) from None
+def _env_bool(name: str, default: str) -> bool:
+    return env.env_str(name, default) not in ("0", "", "false")
 
 
 # per-op latency SLOs (ISSUE 12): SIEVE_SVC_SLO_MS_PI=5 reads as
@@ -209,7 +197,7 @@ _SLO_ENV_PREFIX = "SIEVE_SVC_SLO_MS_"
 
 def _slo_from_env() -> dict[str, float] | None:
     out: dict[str, float] = {}
-    for name, raw in os.environ.items():
+    for name, raw in env.env_items():
         if not name.startswith(_SLO_ENV_PREFIX) or name == _SLO_ENV_PREFIX:
             continue
         try:
@@ -420,11 +408,9 @@ class ServiceSettings:
             ),
             refresh_s=_env_float("SIEVE_SVC_REFRESH_S", cls.refresh_s),
             drain_s=_env_float("SIEVE_SVC_DRAIN_S", cls.drain_s),
-            wire_chaos=os.environ.get("SIEVE_SVC_WIRE_CHAOS", "0")
-            not in ("0", "", "false"),
+            wire_chaos=_env_bool("SIEVE_SVC_WIRE_CHAOS", "0"),
             cold_delay_s=_env_float("SIEVE_SVC_COLD_DELAY_S", cls.cold_delay_s),
-            persist_cold=os.environ.get("SIEVE_SVC_PERSIST_COLD", "0")
-            not in ("0", "", "false"),
+            persist_cold=_env_bool("SIEVE_SVC_PERSIST_COLD", "0"),
             batch_max_chunks=_env_int(
                 "SIEVE_SVC_BATCH_MAX", cls.batch_max_chunks
             ),
@@ -437,16 +423,14 @@ class ServiceSettings:
             hot_workers=_env_int("SIEVE_SVC_HOT_WORKERS", cls.hot_workers),
             cold_age_s=_env_float("SIEVE_SVC_COLD_AGE_S", cls.cold_age_s),
             range_lo=_env_int("SIEVE_SVC_RANGE_LO", cls.range_lo),
-            telemetry_ship=os.environ.get("SIEVE_SVC_TELEMETRY", "0")
-            not in ("0", "", "false"),
+            telemetry_ship=_env_bool("SIEVE_SVC_TELEMETRY", "0"),
             telemetry_batch=_env_int(
                 "SIEVE_SVC_TELEMETRY_BATCH", cls.telemetry_batch
             ),
             slo_ms=_slo_from_env(),
             slo_window=_env_int("SIEVE_SVC_SLO_WINDOW", cls.slo_window),
-            recorder=os.environ.get("SIEVE_SVC_RECORDER", "1")
-            not in ("0", "", "false"),
-            debug_dir=os.environ.get("SIEVE_SVC_DEBUG_DIR") or None,
+            recorder=_env_bool("SIEVE_SVC_RECORDER", "1"),
+            debug_dir=env.env_str("SIEVE_SVC_DEBUG_DIR") or None,
             debug_cooldown_s=_env_float(
                 "SIEVE_SVC_DEBUG_COOLDOWN_S", cls.debug_cooldown_s
             ),
@@ -477,13 +461,14 @@ class ColdBackend:
                  on_transition=None):
         self.config = config
         self.settings = settings
-        self._worker = None  # lazy: a cold-only server may never need it
-        self._lock = threading.Lock()
-        self._state_lock = threading.Lock()
-        self._fail_streak = 0
-        self._down_until = 0.0
-        self._down_reason = ""
-        self._degraded = False
+        self._worker = None  # guard: _lock — lazy; a cold-only
+        # server may never need it
+        self._lock = named_lock("ColdBackend._lock")
+        self._state_lock = named_lock("ColdBackend._state_lock")
+        self._fail_streak = 0  # guard: _state_lock
+        self._down_until = 0.0  # guard: _state_lock
+        self._down_reason = ""  # guard: _state_lock
+        self._degraded = False  # guard: _state_lock
         self._on_transition = on_transition or (lambda entering, reason: None)
 
     def force_down(self, secs: float, reason: str) -> None:
@@ -502,7 +487,8 @@ class ColdBackend:
     @property
     def degraded(self) -> bool:
         self._update_health()
-        return self._degraded
+        with self._state_lock:
+            return self._degraded
 
     def _update_health(self) -> None:
         with self._state_lock:
@@ -631,7 +617,9 @@ class ColdBatcher:
         self.svc = service
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._thread: threading.Thread | None = None
-        self.batches = 0  # dispatch counter: the svc_batch_partial key
+        self.batches = 0  # guard: none(single writer: svc-batcher —
+        # the svc_batch_partial dispatch-counter key; tests drive
+        # _drain_once synchronously)
 
     def start(self) -> "ColdBatcher":
         self._thread = threading.Thread(
@@ -768,12 +756,13 @@ class LedgerFollower:
         assert self._path is not None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._poll_lock = threading.Lock()
+        self._poll_lock = named_lock("LedgerFollower._poll_lock")
         self._last_fp = ledger_fingerprint(self._path)
         self._last_checksum = (
             service.ledger.checksum if service.ledger is not None else None
         )
-        self.attempts = 0  # refresh *attempts* — the svc_refresh_corrupt key
+        self.attempts = 0  # guard: none(single writer: svc-follower —
+        # refresh *attempts*, the svc_refresh_corrupt key)
 
     def start(self) -> "LedgerFollower":
         self._thread = threading.Thread(
@@ -922,24 +911,26 @@ class _Conn:
         self.sock = sock
         self.decoder = FrameDecoder()
         self.wq: collections.deque[bytes] = collections.deque()
-        self.head_off = 0
-        self.wq_bytes = 0
-        self.lock = threading.Lock()
+        self.head_off = 0  # guard: lock
+        self.wq_bytes = 0  # guard: lock
+        self.lock = named_lock("_Conn.lock")
         # serializes actual socket sends: the loop's flush, throttled
         # ticks, and a worker's opportunistic direct send never
         # interleave bytes on the wire
-        self.tx = threading.Lock()
+        self.tx = named_lock("_Conn.tx")
         # True while a send of the head frame is in flight — head_off
         # only records progress AFTER send() returns, so a front-insert
         # must also treat an invisible whole-frame send as "the head is
         # spoken for" or the sender's popleft destroys the wrong frame
-        self.sending = False
-        self.closed = False
+        self.sending = False  # guard: lock
+        self.closed = False  # guard: lock
         # set by writers that cannot touch the selector (slow-consumer
         # overflow): the loop reaps killed conns on its next wakeup
-        self.kill = False
+        self.kill = False  # guard: lock
         # svc_slow_frame chaos: reply bytes per _TICK_S (0 = full speed)
-        self.throttle_bps = 0.0
+        self.throttle_bps = 0.0  # guard: none(written only by the
+        # wire thread; locked worker reads see a current-or-older
+        # budget, both safe)
         self.next_t = 0.0
         self.mask = 0  # selector interest currently registered
 
@@ -962,7 +953,8 @@ class SieveService:
         self._addr_req = addr or "127.0.0.1:0"
         self.metrics = MetricsLogger(config)
         entries = {}
-        self.ledger = None
+        self.ledger = None  # guard: none(reference swap by
+        # svc-follower; readers take one snapshot per message)
         if config.checkpoint_dir:
             self.ledger = self._open_snapshot()
             entries = self.ledger.completed()
@@ -970,25 +962,30 @@ class SieveService:
         # prefix at range_lo, so this server natively speaks shard-local
         # semantics (counts from range_lo, nth >= range_lo)
         self.base = self.settings.range_lo
-        self.index = SieveIndex(
+        self.index = SieveIndex(  # guard: none(follower reference
+            # swap; readers take one snapshot per message)
             config.packing, entries, self.settings.lru_segments,
             base=self.base,
         )
         registry().gauge("cluster.covered_hi").set(
             float(self.index.covered_hi)
         )
-        self._snapshot_ts = trace.now_s()
-        self._refreshes = 0
-        self._refresh_failed = 0
-        self.follower: LedgerFollower | None = None
+        self._snapshot_ts = trace.now_s()  # guard: none(single
+        # writer: svc-follower; float reads are GIL-atomic)
+        self._refreshes = 0  # guard: none(single writer: svc-follower)
+        self._refresh_failed = 0  # guard: none(single writer:
+        # svc-follower)
+        self.follower: LedgerFollower | None = None  # guard: none(set
+        # once in start(); readers null-check)
         self.cold = ColdBackend(config, self.settings, self._on_degraded)
         self.chaos = ChaosSchedule(config.chaos_directives())
-        self._cold_lock = threading.Lock()
+        self._cold_lock = named_lock("SieveService._cold_lock")
         # LRU of chunk results, most-recent at the end: O(1) hit
         # (move_to_end) and O(1) eviction (popitem(last=False)) — the
         # dict+list pair this replaces paid O(n) per eviction
-        self._cold_cache: "collections.OrderedDict" = collections.OrderedDict()
-        self._inflight: dict[tuple[int, int], _Flight] = {}
+        self._cold_cache: "collections.OrderedDict" = (  # guard: _cold_lock
+            collections.OrderedDict())
+        self._inflight: dict[tuple[int, int], _Flight] = {}  # guard: _cold_lock
         self.batcher = ColdBatcher(self)
         # --persist-cold: this server owns the checkpoint dir's ledger
         # as a writer; only the batcher thread ever records into it
@@ -1012,41 +1009,50 @@ class SieveService:
         self._lanes: dict[str, collections.deque] = {
             "hot": collections.deque(), "cold": collections.deque(),
         }
-        self._lane_cond = threading.Condition()
-        self._stopping = False
-        self._seq = 0
-        self._seq_lock = threading.Lock()
-        self._stats = {k: 0 for k in _STATS}
-        self._stats_lock = threading.Lock()
+        self._lane_cond = named_condition("SieveService._lane_cond")
+        self._stopping = False  # guard: _lane_cond
+        self._seq = 0  # guard: _seq_lock
+        self._seq_lock = named_lock("SieveService._seq_lock")
+        self._stats = {k: 0 for k in _STATS}  # guard: _stats_lock
+        self._stats_lock = named_lock("SieveService._stats_lock")
         self._threads: list[threading.Thread] = []
-        self._conns: set[_Conn] = set()
-        self._conns_lock = threading.Lock()
-        self._listener: socket.socket | None = None
+        self._conns: set[_Conn] = set()  # guard: _conns_lock
+        self._conns_lock = named_lock("SieveService._conns_lock")
+        self._listener: socket.socket | None = None  # guard: none(set
+        # once in start() before the loop thread exists; drain/stop
+        # only call shutdown(), never rebind)
         self._bound_addr: str | None = None
-        self._closing = False
+        self._closing = False  # guard: none(monotonic stop flag;
+        # bool reads are GIL-atomic)
         # wire event loop (ISSUE 14): self-wake pipe so worker threads
         # (and drain/stop) can nudge the selector out of its wait
-        self._wake_r: socket.socket | None = None
-        self._wake_w: socket.socket | None = None
+        self._wake_r: socket.socket | None = None  # guard: none(set
+        # once in start() before the loop thread exists)
+        self._wake_w: socket.socket | None = None  # guard: none(set
+        # once in start() before the loop thread exists)
         # graceful drain (ISSUE 8): _inflight_n counts admitted-but-not-
         # replied queries; drain_event fires when draining starts, and
         # _drained once the last in-flight reply is out
-        self._draining = False
-        self._inflight_n = 0
-        self._inflight_lock = threading.Lock()
+        self._draining = False  # guard: none(monotonic drain flag;
+        # a racy reader sheds at most one extra request)
+        self._inflight_n = 0  # guard: _inflight_lock
+        self._inflight_lock = named_lock("SieveService._inflight_lock")
         self.drain_event = threading.Event()
         self._drained = threading.Event()
         # replica_down chaos: while live, every connection is dropped
         # without a reply — a dead replica from the client's side
-        self._replica_down_until = 0.0
+        self._replica_down_until = 0.0  # guard: none(wire-thread
+        # only: the chaos admit path writes and _read_ready reads,
+        # both on svc-wire)
         # per-op SLO tracking (ISSUE 12): rolling latency windows and
         # the set of ops currently burning (p95 over target) — the burn
         # *transition* is the event, the gauge is the live level
-        self._slo_lock = threading.Lock()
-        self._slo_windows: dict[str, collections.deque] = {}
-        self._slo_burning: set[str] = set()
+        self._slo_lock = named_lock("SieveService._slo_lock")
+        self._slo_windows: dict[str, collections.deque] = {}  # guard: _slo_lock
+        self._slo_burning: set[str] = set()  # guard: _slo_lock
         # telemetry shipping: armed in start() when telemetry_ship is on
-        self._telemetry_on = False
+        self._telemetry_on = False  # guard: none(armed once in
+        # start(); bool reads are GIL-atomic)
         # flight recorder (ISSUE 13): trend sampler + black-box capture,
         # armed in start(); edge triggers (SLO burn, breaker open,
         # crash) freeze bundles under settings.debug_dir
@@ -1154,8 +1160,10 @@ class SieveService:
                 pass
         self._wake()
         hot, cold = self._lane_depths()
+        with self._inflight_lock:
+            inflight = self._inflight_n
         self.metrics.event("service_drain", queued=hot + cold,
-                           inflight=self._inflight_n)
+                           inflight=inflight)
         registry().gauge("service.draining").set(1.0)
         self.drain_event.set()
         self._maybe_drained()
@@ -1260,7 +1268,7 @@ class SieveService:
                     "slo_burn", op=op, p95_ms=round(p95, 3), slo_ms=target,
                 )
 
-    def _win_burn_locked(self, op: str) -> float:
+    def _win_burn_locked(self, op: str) -> float:  # holds: _slo_lock
         win = self._slo_windows.get(op)
         target = (self.settings.slo_ms or {}).get(op)
         if not win or not target:
@@ -1655,12 +1663,14 @@ class SieveService:
         frame = encode_msg(payload)
         overflow = False
         direct = False
+        queued = 0
         with c.lock:
             if c.closed or c.kill:
                 return
             if c.wq_bytes + len(frame) > self.settings.write_queue_bytes:
                 c.kill = True
                 overflow = True
+                queued = c.wq_bytes
             else:
                 if front:
                     busy_head = (c.head_off > 0 or c.sending) and c.wq
@@ -1673,7 +1683,7 @@ class SieveService:
         if overflow:
             self._bump("slow_consumer_closed")
             self.metrics.event("service_slow_consumer", quietable=True,
-                               queued_bytes=c.wq_bytes,
+                               queued_bytes=queued,
                                limit=self.settings.write_queue_bytes)
             self._wake()
             return

@@ -46,6 +46,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from sieve.analysis.lockdebug import named_lock
 import time
 from typing import Any, TextIO
 
@@ -93,7 +95,7 @@ class Tracer:
     Chrome trace-event capture."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("Tracer._lock")
         self.enabled = False
         self._events: list[dict] = []
         self._totals: dict[str, list] = {}  # name -> [total_s, count]

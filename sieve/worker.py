@@ -24,7 +24,7 @@ from typing import TYPE_CHECKING
 
 import numpy as np
 
-from sieve import trace
+from sieve import env, trace
 from sieve.metrics import registry
 
 if TYPE_CHECKING:
@@ -38,7 +38,7 @@ TELEMETRY_RING_EVENTS = 4096
 
 def telemetry_ring_size() -> int:
     """Ring capacity: ``SIEVE_TELEMETRY_RING`` env override, 0 disables."""
-    return int(os.environ.get("SIEVE_TELEMETRY_RING", TELEMETRY_RING_EVENTS))
+    return env.env_int("SIEVE_TELEMETRY_RING", TELEMETRY_RING_EVENTS)
 
 
 def telemetry_start() -> bool:

@@ -1,0 +1,29 @@
+"""Clean fixture: consistent lock order, guarded state, no blocking
+from the loop role."""
+
+import threading
+
+
+class W:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0  # guard: _a
+        self.m = 0  # guard: _b
+
+    def start(self):
+        threading.Thread(target=self._run, name="w-1").start()
+
+    def _run(self):
+        while True:
+            self.step()
+
+    def step(self):
+        with self._a:
+            self.n += 1
+            with self._b:
+                self.m += 1
+
+    def peek(self):
+        with self._a:
+            return self.n

@@ -1,0 +1,27 @@
+"""Seeded defect: two locks acquired in opposite orders (deadlock)."""
+
+import threading
+
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0  # guard: _a
+
+    def start(self):
+        threading.Thread(target=self._run, name="cyc-1").start()
+
+    def _run(self):
+        while True:
+            self.forward()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                self.n -= 1

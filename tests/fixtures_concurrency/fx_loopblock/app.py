@@ -1,0 +1,16 @@
+"""Seeded defect: a blocking call reachable from an event-loop role."""
+
+import threading
+import time
+
+
+class Loop:
+    def start(self):
+        threading.Thread(target=self._loop, name="ev-loop").start()
+
+    def _loop(self):
+        while True:
+            self._tick()
+
+    def _tick(self):
+        time.sleep(0.1)

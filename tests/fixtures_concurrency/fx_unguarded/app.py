@@ -1,0 +1,20 @@
+"""Seeded defect: a ``# guard:``-annotated attribute touched without
+its lock from a function two roles reach."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # guard: _lock
+
+    def start(self):
+        threading.Thread(target=self._run, name="mut-1").start()
+
+    def _run(self):
+        while True:
+            self.bump()
+
+    def bump(self):
+        self.count += 1

@@ -1,0 +1,227 @@
+"""Concurrency analyzer + runtime lock sanitizer (ISSUE 15).
+
+Covers: the static pass end to end on four committed fixtures (a
+seeded lock-order cycle, a blocking call reachable from an event-loop
+role, a ``# guard:``-annotated attribute touched without its lock, and
+a clean package that must produce zero findings); the real repo being
+clean against the committed baseline (the ratchet gate itself);
+``tools/check_all`` aggregating every static gate; the env-var
+discipline checker's two rules (raw-read detection, README coverage);
+and the ``SIEVE_LOCK_DEBUG`` wrappers — recording, RLock reentry,
+Condition.wait release/reacquire, and ``check_static_consistency``
+agreeing/disagreeing with a canonical order.
+"""
+
+import ast
+import threading
+from pathlib import Path
+
+import pytest
+
+from sieve.analysis import checks, core, lockdebug
+from sieve.analysis.model import Model, default_model
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures_concurrency"
+
+
+def _analyze_fixture(name: str, **model_kw) -> list[checks.Finding]:
+    prog = core.scan(str(FIXTURES / name), pkg=name)
+    return checks.analyze(prog, Model(**model_kw))
+
+
+# --- static pass on the fixtures ---------------------------------------------
+
+
+def test_fixture_lock_cycle_detected():
+    findings = _analyze_fixture(
+        "fx_cycle",
+        canonical_lock_order=("C._a", "C._b"),
+        app_role_classes=frozenset({"C"}),
+    )
+    kinds = {f.kind for f in findings}
+    assert "lock-cycle" in kinds
+    # the edge against the canonical order is reported at its site
+    assert any(f.key == "lock-order:C._b->C._a@fx_cycle.app:C.backward"
+               for f in findings)
+
+
+def test_fixture_loop_blocking_detected():
+    findings = _analyze_fixture(
+        "fx_loopblock",
+        loop_roles=frozenset({"ev-loop"}),
+        blocking_calls=frozenset({"time.sleep"}),
+    )
+    assert [f.key for f in findings] == [
+        "loop-blocking:ev-loop:fx_loopblock.app:Loop._tick:time.sleep"
+    ]
+
+
+def test_fixture_unguarded_attr_detected():
+    findings = _analyze_fixture(
+        "fx_unguarded",
+        canonical_lock_order=("Worker._lock",),
+        app_role_classes=frozenset({"Worker"}),
+    )
+    assert [f.key for f in findings] == [
+        "guard:Worker.count@fx_unguarded.app:Worker.bump"
+    ]
+
+
+def test_fixture_clean_has_no_findings():
+    findings = _analyze_fixture(
+        "fx_clean",
+        canonical_lock_order=("W._a", "W._b"),
+        app_role_classes=frozenset({"W"}),
+    )
+    assert findings == []
+
+
+def test_fixture_roles_derive_from_spawn_names():
+    prog = core.scan(str(FIXTURES / "fx_loopblock"), pkg="fx_loopblock")
+    roles = checks.assign_roles(prog, Model(loop_roles=frozenset({"ev-loop"})))
+    assert roles["fx_loopblock.app:Loop._loop"] == {"ev-loop"}
+    assert roles["fx_loopblock.app:Loop._tick"] == {"ev-loop"}
+    # the spawning function itself is not the spawned role
+    assert "ev-loop" not in roles.get("fx_loopblock.app:Loop.start", set())
+
+
+# --- the repo itself ---------------------------------------------------------
+
+
+def test_repo_is_clean_against_baseline():
+    import tools.check_concurrency as cc
+    new, stale = cc.check()
+    assert new == [], "\n".join(str(f) for f in new)
+    assert stale == []
+
+
+def test_repo_lock_graph_is_acyclic_and_listed():
+    prog = core.scan(str(REPO / "sieve"), pkg="sieve")
+    model = default_model()
+    edges = checks.lock_edges(prog)
+    idx = {lk: i for i, lk in enumerate(model.canonical_lock_order)}
+    for a, b in edges:
+        assert a in idx, f"unlisted lock {a}"
+        assert b in idx, f"unlisted lock {b}"
+        assert idx[a] < idx[b], f"edge {a} -> {b} against canonical order"
+
+
+def test_check_all_passes():
+    import tools.check_all as ca
+    assert ca.main([]) == 0
+
+
+# --- env-var discipline ------------------------------------------------------
+
+
+def test_env_vars_check_is_clean():
+    import tools.check_env_vars as cev
+    problems, names = cev.scan()
+    assert problems == []
+    assert cev.undocumented(names) == []
+    assert "SIEVE_LOCK_DEBUG" in names
+
+
+def test_env_vars_check_catches_raw_reads():
+    import tools.check_env_vars as cev
+    src = (
+        "import os\n"
+        "a = os.environ.get('SIEVE_FAKE_A')\n"
+        "b = os.environ['SIEVE_FAKE_B']\n"
+        "c = os.getenv('SIEVE_FAKE_C', '1')\n"
+        # writes are legal: defaults for children, child-env dicts
+        "os.environ.setdefault('SIEVE_FAKE_D', '1')\n"
+        "os.environ['SIEVE_FAKE_E'] = '1'\n"
+        "wenv = {**os.environ, 'SIEVE_FAKE_F': '1'}\n"
+    )
+    sc = cev._Scanner("fake.py")
+    sc.visit(ast.parse(src))
+    assert sorted(n for _, n in sc.raw_reads) == [
+        "SIEVE_FAKE_A", "SIEVE_FAKE_B", "SIEVE_FAKE_C"
+    ]
+
+
+# --- runtime sanitizer -------------------------------------------------------
+
+
+@pytest.fixture
+def fresh_recorder():
+    rec = lockdebug.recorder()
+    rec.reset()
+    yield rec
+    rec.reset()
+
+
+def test_named_lock_is_plain_threading_when_disabled(monkeypatch):
+    monkeypatch.delenv("SIEVE_LOCK_DEBUG", raising=False)
+    assert type(lockdebug.named_lock("X.a")) is type(threading.Lock())
+    assert isinstance(lockdebug.named_condition("X.c"), threading.Condition)
+
+
+def test_debug_lock_records_nesting(monkeypatch, fresh_recorder):
+    monkeypatch.setenv("SIEVE_LOCK_DEBUG", "1")
+    a = lockdebug.named_lock("T.a")
+    b = lockdebug.named_lock("T.b")
+    with a:
+        with b:
+            pass
+    with a:
+        pass  # no pair: nothing else held
+    assert lockdebug.observed_pairs() == {("T.a", "T.b"): 1}
+    assert lockdebug.check_static_consistency(("T.a", "T.b")) == []
+    problems = lockdebug.check_static_consistency(("T.b", "T.a"))
+    assert problems and "against the canonical order" in problems[0]
+
+
+def test_debug_lock_unknown_lock_is_a_problem(monkeypatch, fresh_recorder):
+    monkeypatch.setenv("SIEVE_LOCK_DEBUG", "1")
+    a = lockdebug.named_lock("T.a")
+    b = lockdebug.named_lock("T.rogue")
+    with a, b:
+        pass
+    problems = lockdebug.check_static_consistency(("T.a",))
+    assert any("not in canonical order" in p for p in problems)
+
+
+def test_debug_rlock_reentry_not_a_self_pair(monkeypatch, fresh_recorder):
+    monkeypatch.setenv("SIEVE_LOCK_DEBUG", "1")
+    r = lockdebug.named_rlock("T.r")
+    with r:
+        with r:  # legal reentry must not record (T.r, T.r)
+            pass
+    assert lockdebug.observed_pairs() == {}
+    assert lockdebug.check_static_consistency(("T.r",)) == []
+
+
+def test_debug_condition_wait_releases_for_ordering(monkeypatch,
+                                                    fresh_recorder):
+    monkeypatch.setenv("SIEVE_LOCK_DEBUG", "1")
+    outer = lockdebug.named_lock("T.outer")
+    cond = lockdebug.named_condition("T.cond")
+
+    def waker():
+        with cond:
+            cond.notify_all()
+
+    with outer:
+        with cond:
+            t = threading.Thread(target=waker)
+            t.start()
+            cond.wait(timeout=5.0)
+            t.join()
+    pairs = lockdebug.observed_pairs()
+    # entry nesting plus the reacquire after the wake — both are
+    # outer -> cond (deduped per thread), which the order must allow
+    assert ("T.outer", "T.cond") in pairs
+    assert lockdebug.check_static_consistency(("T.outer", "T.cond")) == []
+
+
+def test_smoke_scripts_assert_lock_orders():
+    # the dynamic half is wired into both smokes, right before their
+    # success banner — keep it that way
+    for smoke in ("service_smoke.py", "chaos_smoke.py"):
+        src = (REPO / "tools" / smoke).read_text()
+        assert "check_static_consistency" in src, smoke
+        body = src[src.index("def _assert_lock_orders"):]
+        assert "_assert_lock_orders()" in body, smoke

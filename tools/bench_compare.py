@@ -33,9 +33,15 @@ import re
 import sys
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
-# the fleet-tracing budget (ISSUE 12): traced p95 / untraced p95 must
-# stay within 5% — an absolute gate, not a round-over-round one
-_OVERHEAD_CEILING = 1.05
+# absolute overhead budgets (gates, not round-over-round diffs): every
+# ``overhead_ratio`` metric must stay under its ceiling. Default 1.05
+# (the fleet-tracing/recorder budget, ISSUE 12/13); the lock sanitizer
+# gets 1.10 — it wraps every lock in the plane and is a debug mode,
+# not an always-on tax (ISSUE 15).
+_DEFAULT_OVERHEAD_CEILING = 1.05
+_OVERHEAD_CEILINGS = {
+    "service_lock_debug_overhead_ratio": 1.10,
+}
 
 
 def find_rounds(bench_dir: str, prefix: str) -> list[tuple[int, str]]:
@@ -84,15 +90,16 @@ def compare(
         o, n = old.get(name), new.get(name)
         # absolute ceilings apply regardless of history — including a
         # metric's very first round, where there is no old value to diff
+        ceiling = _OVERHEAD_CEILINGS.get(name, _DEFAULT_OVERHEAD_CEILING)
         if n is not None and n.get("unit") == "overhead_ratio" \
-                and float(n["value"]) > _OVERHEAD_CEILING:
+                and float(n["value"]) > ceiling:
             regressions.append(
                 f"{name}: {float(n['value']):.4g} exceeds the absolute "
-                f"{_OVERHEAD_CEILING} overhead ceiling"
+                f"{ceiling} overhead ceiling"
             )
             lines.append(
                 f"  {name}: {float(n['value']):.4g} overhead_ratio  "
-                f"REGRESSION (> {_OVERHEAD_CEILING} absolute ceiling)"
+                f"REGRESSION (> {ceiling} absolute ceiling)"
             )
             continue
         if o is None:

@@ -37,6 +37,24 @@ def fail(msg: str) -> None:
     sys.exit(1)
 
 
+def _assert_lock_orders() -> None:
+    """SIEVE_LOCK_DEBUG=1: the orders the run actually acquired must
+    agree with the static canonical order (sieve/analysis/model.py) —
+    the smoke is the dynamic half of the concurrency gate."""
+    from sieve import env
+    from sieve.analysis import lockdebug
+
+    if not env.env_flag("SIEVE_LOCK_DEBUG"):
+        return
+    problems = lockdebug.check_static_consistency()
+    if problems:
+        fail("lock sanitizer: observed orders disagree with the static "
+             "graph:\n  " + "\n  ".join(problems))
+    print(f"lock debug OK: {len(lockdebug.observed_pairs())} observed "
+          f"acquisition orders consistent with the static graph",
+          flush=True)
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--n", type=int, default=10**5)
@@ -95,6 +113,7 @@ def main(argv: list[str] | None = None) -> int:
                  f"oracle {oracle.pi}/{oracle.twin_pairs}")
         print(f"phase 2 OK: pi={res2.pi} twins={res2.twin_pairs} "
               f"(salvage + resume exact)", flush=True)
+        _assert_lock_orders()
         print("CHAOS_SMOKE_OK", flush=True)
         return 0
     finally:

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""Run every static gate, one line per check, one exit code.
+
+The individual checkers stay runnable on their own (each prints its
+own diagnostics to stderr); this runner exists so CI and humans have a
+single command that cannot silently skip a gate. Adding a checker
+means adding a ``(name, main)`` pair to ``CHECKS``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for p in (REPO, os.path.join(REPO, "tools")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+import check_concurrency  # noqa: E402
+import check_env_vars  # noqa: E402
+import check_event_schema  # noqa: E402
+import check_wire_ops  # noqa: E402
+
+#: (display name, argv-style main returning an exit code)
+CHECKS = (
+    ("wire_ops", check_wire_ops.main),
+    ("event_schema", check_event_schema.main),
+    ("concurrency", check_concurrency.main),
+    ("env_vars", check_env_vars.main),
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    failed = []
+    for name, entry in CHECKS:
+        try:
+            rc = entry([])
+        except Exception as exc:  # a crashed checker is a failed checker
+            print(f"check_all: {name} crashed: {exc!r}", file=sys.stderr)
+            rc = 2
+        print(f"check_all: {name}: {'ok' if rc == 0 else f'FAILED ({rc})'}")
+        if rc != 0:
+            failed.append(name)
+    if failed:
+        print(f"check_all: FAILED ({', '.join(failed)})", file=sys.stderr)
+        return 1
+    print(f"check_all: ok ({len(CHECKS)} checks)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,24 @@ def expect(desc: str, got, want) -> None:
         fail(f"{desc}: got {got!r}, want {want!r}")
 
 
+def _assert_lock_orders() -> None:
+    """SIEVE_LOCK_DEBUG=1: the orders the run actually acquired must
+    agree with the static canonical order (sieve/analysis/model.py) —
+    the smoke is the dynamic half of the concurrency gate."""
+    from sieve import env
+    from sieve.analysis import lockdebug
+
+    if not env.env_flag("SIEVE_LOCK_DEBUG"):
+        return
+    problems = lockdebug.check_static_consistency()
+    if problems:
+        fail("lock sanitizer: observed orders disagree with the static "
+             "graph:\n  " + "\n  ".join(problems))
+    print(f"lock debug OK: {len(lockdebug.observed_pairs())} observed "
+          f"acquisition orders consistent with the static graph",
+          flush=True)
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--n", type=int, default=200_000)
@@ -518,6 +536,7 @@ def main(argv: list[str] | None = None) -> int:
               f" (bound {bound * 1e3:.2f} ms); cold outcomes {tally7}; "
               f"lane_shed_cold={s7['lane_shed_cold']} "
               f"demoted={s7['demoted']}", flush=True)
+        _assert_lock_orders()
         print("SERVICE_SMOKE_OK", flush=True)
         return 0
     finally:
