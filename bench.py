@@ -391,7 +391,15 @@ def service_hot_qps_metric() -> None:
     host oracle. ``service_hot_qps`` is the batched number; its
     ``vs_baseline`` is batched/sequential and the acceptance bar is
     >=10x at a sequential hot p95 no worse than BENCH_r09's. Gated
-    round-over-round by tools/bench_compare.py's ``qps`` rule."""
+    round-over-round by tools/bench_compare.py's ``qps`` rule.
+
+    The three legs above run on a ``negotiate=False`` client so the
+    ``service_hot_qps`` line keeps measuring the JSON v1 wire it always
+    measured. A second, negotiated connection then re-runs the batched
+    loop through the binary columnar frames (ISSUE 16) and emits
+    ``service_hot_qps_binary`` plus ``service_wire_bytes_per_member``
+    (sent+received bytes per batch member, binary vs JSON — gated by an
+    absolute ceiling in bench_compare, like the overhead ratios)."""
     import tempfile
 
     import numpy as np
@@ -424,7 +432,8 @@ def service_hot_qps_metric() -> None:
             workers=4, queue_limit=512, cold_chunk=chunk, refresh_s=0.0,
         )
         with SieveService(cfg, settings) as svc, \
-                ServiceClient(svc.addr, timeout_s=60) as cli:
+                ServiceClient(svc.addr, timeout_s=60,
+                              negotiate=False) as cli:
             for x, w in zip(xs[:64], want[:64]):  # warm index/LRU paths
                 assert cli.pi(x) == w, f"warm pi({x}) parity failure"
 
@@ -462,6 +471,35 @@ def service_hot_qps_metric() -> None:
                         f"batch pi parity failure: {o!r}"
             batch_qps = reps_b * len(xs) / (time.perf_counter() - t0)
 
+            # JSON wire cost for the bytes-per-member comparison: one
+            # batch with the counters read around it
+            js0, jr0 = cli.bytes_sent, cli.bytes_recv
+            cli.query_batch(items)
+            json_bpm = (cli.bytes_sent - js0 + cli.bytes_recv - jr0) \
+                / len(xs)
+
+            # binary wire v2 (ISSUE 16): same members, same oracle, on a
+            # freshly negotiated connection — columnar frames end-to-end
+            with ServiceClient(svc.addr, timeout_s=60) as cli2:
+                assert cli2.wire_v == 2, "binary v2 negotiation failed"
+                lat2_ms: list[float] = []
+                for x, w in zip(xs, want):
+                    c0 = time.perf_counter()
+                    assert cli2.pi(x) == w, \
+                        f"v2 seq pi({x}) parity failure"
+                    lat2_ms.append((time.perf_counter() - c0) * 1000.0)
+                t0 = time.perf_counter()
+                for _ in range(reps_b):
+                    outs = cli2.query_batch(items)
+                    for o, w in zip(outs, want):
+                        assert o.get("ok") and o["value"] == w, \
+                            f"v2 batch pi parity failure: {o!r}"
+                bin_qps = reps_b * len(xs) / (time.perf_counter() - t0)
+                bs0, br0 = cli2.bytes_sent, cli2.bytes_recv
+                cli2.query_batch(items)
+                bin_bpm = (cli2.bytes_sent - bs0 + cli2.bytes_recv
+                           - br0) / len(xs)
+
     hot_p95 = _pctile(lat_ms, 0.95)
     print(
         json.dumps(
@@ -485,6 +523,29 @@ def service_hot_qps_metric() -> None:
                 "unit": "qps",
                 "vs_baseline": round(pipe_qps / seq_qps, 2),
                 "queries": reps_p * len(xs),
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "service_hot_qps_binary",
+                "value": round(bin_qps, 1),
+                "unit": "qps",
+                "vs_json": round(bin_qps / batch_qps, 2),
+                "hot_p95_ms": round(_pctile(lat2_ms, 0.95), 3),
+                "queries": reps_b * len(xs),
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "service_wire_bytes_per_member",
+                "value": round(bin_bpm, 1),
+                "unit": "bytes_per_member",
+                "json_bytes_per_member": round(json_bpm, 1),
+                "vs_json": round(bin_bpm / json_bpm, 2),
             }
         )
     )

@@ -82,6 +82,10 @@ CANONICAL_LOCK_ORDER: tuple[str, ...] = (
     # -- index tier
     "SieveIndex._stat_lock",
     "BitsetLRU._lock",
+    # -- client wire-event logger init (ISSUE 16): taken during client
+    #    construction (possibly under _Replica.lock) and released
+    #    before the metrics leaf locks below are touched
+    "client._wire_logger_lock",
     # -- leaf infrastructure (innermost: never call out while held)
     "ChaosSchedule._lock",
     "FlightRecorder._lock",

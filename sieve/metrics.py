@@ -139,6 +139,11 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     # overflowed ("queued_bytes" = bytes pending when the cap tripped).
     "service_slow_frame": {"bytes_per_tick"},
     "service_slow_consumer": {"queued_bytes"},
+    # binary wire v2 (ISSUE 16): a v2-capable client whose hello came
+    # back v1-only — "negotiated" is the version the peer settled on.
+    # Emitted once per downgraded connection so a supposedly-binary
+    # fleet silently running JSON is visible in the metrics stream.
+    "wire_downgrade": {"addr", "negotiated"},
 }
 
 

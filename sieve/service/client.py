@@ -55,15 +55,30 @@ from __future__ import annotations
 import itertools
 import random
 import socket
+import struct
 import threading
 import time
 import uuid
 from typing import Any, Sequence
 
-from sieve import trace
+import numpy as np
+
+from sieve import env, trace
 from sieve.analysis.lockdebug import named_lock
 from sieve.metrics import registry
-from sieve.rpc import parse_addr, recv_msg, send_msg
+from sieve.rpc import (
+    SUPPORTED_WIRE,
+    WIRE_V1,
+    WIRE_V2,
+    _recv_exact,
+    batch_items_to_cols,
+    batch_reply_value,
+    decode_body,
+    encode_msg,
+    encode_msg_v2,
+    parse_addr,
+    primes_reply_value,
+)
 
 
 class ServiceError(RuntimeError):
@@ -88,10 +103,39 @@ class CallTimeout(ServiceError):
         super().__init__("timeout", detail)
 
 
+#: lazily-built logger for client-side wire events (the client has no
+#: config of its own — same quiet-shim trick the router uses)
+_wire_logger = None
+_wire_logger_lock = named_lock("client._wire_logger_lock")
+
+
+def _emit_wire_downgrade(addr: str, negotiated: int) -> None:
+    global _wire_logger
+    import types as _types
+
+    from sieve.metrics import MetricsLogger
+
+    with _wire_logger_lock:
+        if _wire_logger is None:
+            _wire_logger = MetricsLogger(_types.SimpleNamespace(quiet=True))
+        logger = _wire_logger
+    registry().counter("wire.downgrade").inc()
+    logger.event("wire_downgrade", quietable=True, addr=addr,
+                 negotiated=negotiated)
+
+
 class ServiceClient:
-    def __init__(self, addr: str, timeout_s: float = 60.0):
+    def __init__(self, addr: str, timeout_s: float = 60.0,
+                 negotiate: bool | None = None, keep_arrays: bool = False):
         host, port = parse_addr(addr)
+        self._addr = addr
         self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        try:
+            # request frames are one sendall each; never Nagle-hold the
+            # tail segment of a multi-segment binary batch (ISSUE 16)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
         self._ids = itertools.count(1)
         self._run_id = uuid.uuid4().hex[:8]
         self._ctx_seq = itertools.count(1)
@@ -100,6 +144,54 @@ class ServiceClient:
         # and replies that arrived before their turn (id → reply)
         self._pending: dict[Any, float] = {}
         self._replies: dict[Any, dict] = {}
+        # binary wire v2 (ISSUE 16): negotiated send version, member-op
+        # memory for columnar batches in flight (id → op names), raw
+        # wire byte counters (the bytes-per-member bench reads them),
+        # and keep_arrays=True hands decoded ``primes`` values out as
+        # int64 arrays instead of lists (the router's shard legs — no
+        # round trip through Python ints on a pass-through)
+        self.wire_v = WIRE_V1  # guard: none(written only during
+        # __init__'s hello, before the client is shared; readers after
+        # that see a frozen value)
+        self.downgraded = False  # guard: none(same write-once-in-init
+        # discipline as wire_v)
+        self.keep_arrays = keep_arrays
+        self.bytes_sent = 0  # guard: none(ServiceClient is documented
+        # single-thread-per-call; counters ride the caller's thread)
+        self.bytes_recv = 0  # guard: none(see bytes_sent)
+        self._batch_ops: dict[Any, list] = {}  # guard: none(touched
+        # only inside _send/_recv_for on the caller's thread, same as
+        # _pending/_replies above)
+        if negotiate is None:
+            negotiate = env.env_flag("SIEVE_WIRE_V2", True)
+        if negotiate:
+            self._negotiate()
+
+    def _negotiate(self) -> None:
+        """The wire hello: offer ``SUPPORTED_WIRE``, adopt the server's
+        pick. A v1-only peer answers ``wire: 1`` (or, pre-negotiation
+        builds, a typed bad_request) — either way the client stays on
+        JSON and logs ONE ``wire_downgrade`` event (+ counter), so a
+        silently degraded fleet is visible in metrics (ISSUE 16)."""
+        try:
+            reply = self._call({"type": "hello",
+                                "wire": list(SUPPORTED_WIRE)})
+        except (CallTimeout, ConnectionError, OSError):
+            # a connection dying under the hello is an outage, not a
+            # protocol downgrade: close it and let the FIRST REAL CALL
+            # raise the ConnectionError — the exact place a
+            # pre-negotiation client would have surfaced it (the
+            # constructor itself never sent anything back then)
+            self.close()
+            return
+        if reply.get("type") == "hello" and reply.get("ok"):
+            try:
+                self.wire_v = int(reply.get("wire") or WIRE_V1)
+            except (TypeError, ValueError):
+                self.wire_v = WIRE_V1
+        if self.wire_v < WIRE_V2:
+            self.downgraded = True
+            _emit_wire_downgrade(self._addr, self.wire_v)
 
     def close(self) -> None:
         self._dead = True
@@ -124,7 +216,19 @@ class ServiceClient:
                 "stream); open a new client"
             )
         rid = msg.setdefault("id", next(self._ids))
-        send_msg(self._sock, msg)
+        frame = None
+        if (self.wire_v >= WIRE_V2 and msg.get("op") == "batch"
+                and "items" in msg):
+            packed = batch_items_to_cols(msg["items"])
+            if packed is not None:
+                cols, ops = packed
+                header = {k: v for k, v in msg.items() if k != "items"}
+                frame = encode_msg_v2(header, cols)
+                self._batch_ops[rid] = ops
+        if frame is None:
+            frame = encode_msg(msg)
+        self._sock.sendall(frame)
+        self.bytes_sent += len(frame)
         self._pending[rid] = trace.now_s()
         return rid
 
@@ -137,7 +241,7 @@ class ServiceClient:
             return self._replies.pop(rid)
         while True:
             try:
-                reply = recv_msg(self._sock)
+                reply = self._recv()
             except socket.timeout:
                 # requests are still in flight server-side: a later recv
                 # on this socket would read THEIR replies as its own —
@@ -151,10 +255,42 @@ class ServiceClient:
             if reply is None:
                 raise ConnectionError("service closed the connection")
             got = reply.get("id")
+            self._rehydrate(got, reply)
             if got == rid:
                 self._pending.pop(rid, None)
                 return reply
             self._replies[got] = reply
+
+    def _recv(self) -> dict | None:
+        """One frame off the socket, counted into ``bytes_recv``."""
+        header = _recv_exact(self._sock, 8)
+        if header is None:
+            return None
+        (length,) = struct.unpack(">Q", header)
+        blob = _recv_exact(self._sock, length)
+        if blob is None:
+            return None
+        self.bytes_recv += 8 + length
+        return decode_body(blob)
+
+    def _rehydrate(self, rid, reply: dict) -> None:
+        """Rebuild the v1-shaped ``value`` from a v2 columnar reply, in
+        place — callers above this point never see columns. A JSON
+        reply (including a whole-batch error for a columnar request)
+        passes through untouched."""
+        if "_cols" not in reply:
+            self._batch_ops.pop(rid, None)
+            return
+        del reply["_cols"]
+        vkind = reply.pop("vkind", None)
+        if vkind == "batch":
+            reply["value"] = batch_reply_value(
+                reply, self._batch_ops.pop(rid, None)
+            )
+        elif vkind == "primes":
+            reply["value"] = primes_reply_value(
+                reply, as_array=self.keep_arrays
+            )
 
     def _call(self, msg: dict) -> dict:
         return self._recv_for(self._send(msg))
@@ -365,6 +501,8 @@ class ReplicaSet:
         backoff_cap_s: float = 1.0,
         circuit_cooldown_s: float = 1.0,
         probe_ttl_s: float | None = None,
+        negotiate: bool | None = None,
+        keep_arrays: bool = False,
     ):
         if not addrs:
             raise ValueError("ReplicaSet needs at least one address")
@@ -388,6 +526,22 @@ class ReplicaSet:
         # observability for tools/tests: how often selection failed over
         self.failovers = 0
         self.probes = 0
+        # wire v2 (ISSUE 16): per-connection negotiation preference
+        # (None = SIEVE_WIRE_V2 env default), array pass-through for
+        # the router's shard legs, and how many fresh connections came
+        # up downgraded to v1 JSON (surfaced in router stats)
+        self.negotiate = negotiate
+        self.keep_arrays = keep_arrays
+        self.downgrades = 0
+
+    def _connect(self, addr: str) -> ServiceClient:
+        cli = ServiceClient(addr, timeout_s=self.timeout_s,
+                            negotiate=self.negotiate,
+                            keep_arrays=self.keep_arrays)
+        if cli.downgraded:
+            with self._lock:
+                self.downgrades += 1
+        return cli
 
     def close(self) -> None:
         for rep in self._replicas:
@@ -452,7 +606,7 @@ class ReplicaSet:
         stays trusted for that window — the counters make the cache
         provable (``router.probe_cached`` vs ``router.probe_sent``)."""
         if rep.client is None:
-            rep.client = ServiceClient(rep.addr, timeout_s=self.timeout_s)
+            rep.client = self._connect(rep.addr)
             rep.probed = 0.0
         now = time.monotonic()
         if self._probe_fresh(rep, now):
@@ -667,9 +821,7 @@ class ReplicaSet:
             try:
                 with rep.lock:
                     if rep.client is None:
-                        rep.client = ServiceClient(
-                            rep.addr, timeout_s=self.timeout_s
-                        )
+                        rep.client = self._connect(rep.addr)
                     rep.client._sock.settimeout(self.probe_timeout_s)
                     try:
                         return rep.client.health()
@@ -693,9 +845,7 @@ class ReplicaSet:
             try:
                 with rep.lock:
                     if rep.client is None:
-                        rep.client = ServiceClient(
-                            rep.addr, timeout_s=self.timeout_s
-                        )
+                        rep.client = self._connect(rep.addr)
                     return rep.client.metrics()
             except (ConnectionError, OSError, CallTimeout) as e:
                 self._mark_down(rep)
@@ -721,9 +871,7 @@ class ReplicaSet:
             try:
                 with rep.lock:
                     if rep.client is None:
-                        rep.client = ServiceClient(
-                            rep.addr, timeout_s=self.timeout_s
-                        )
+                        rep.client = self._connect(rep.addr)
                     t_send = round(trace.now_s(), 6)
                     reply = rep.client._call(
                         {"type": "telemetry", "t_send": t_send}

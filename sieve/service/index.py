@@ -158,6 +158,9 @@ class SieveIndex:
         # content depends only on (packing, lo, hi), never on ledger
         # entries, so cached chunks are exact under any snapshot
         self.lru = lru if lru is not None else BitsetLRU(lru_segments)
+        # chunk prime-value arrays for count_upto_batch (ISSUE 16): same
+        # (lo, hi) keys as the flags LRU, content equally snapshot-free
+        self._pv = BitsetLRU(lru_segments)
         self._stat_lock = named_lock("SieveIndex._stat_lock")
         self.lru_hits = 0  # guard: _stat_lock
         self.materialized = 0  # guard: _stat_lock
@@ -267,9 +270,10 @@ class SieveIndex:
         gather over ``_prefix`` answers every segment-boundary hit —
         the per-value bisect/branch cost of M scalar ``count_upto``
         calls collapses into two array ops. Values that land strictly
-        inside a segment still need flag popcounts and fall back to the
-        scalar path individually (their LRU chunks stay hot across the
-        batch). Same domain contract as ``count_upto``: every value in
+        inside a segment are grouped by segment and answered one chunk
+        at a time with a single searchsorted against the chunk's cached
+        prime values (:meth:`_count_interior`) — no per-value popcount
+        walk. Same domain contract as ``count_upto``: every value in
         [base, covered_hi]."""
         arr = np.asarray(list(vs), dtype=np.int64)
         out = np.zeros(arr.size, dtype=np.int64)
@@ -300,9 +304,74 @@ class SieveIndex:
         if bool(boundary.any()):
             ctx.count_so_far = max(ctx.count_so_far,
                                    int(out[boundary].max()))
-        for i in np.nonzero(~boundary)[0]:
-            out[i] = self.count_upto(int(arr[i]), ctx)
+        interior = np.nonzero(~boundary)[0]
+        if interior.size:
+            ji = j[interior]
+            for sj in np.unique(ji):
+                sel = interior[ji == sj]
+                self._count_interior(int(sj), arr[sel], out, sel, ctx)
         return out
+
+    def _count_interior(self, sj: int, varr: np.ndarray, out: np.ndarray,
+                        sel: np.ndarray, ctx: QueryCtx) -> None:
+        """Answer a batch of strictly-interior values of segment ``sj``
+        in one vectorized row per chunk.
+
+        The scalar fallback (one :meth:`count_upto` per value) repeats a
+        full-chunk popcount walk for every value — the dominant cost of
+        a hot batch (ISSUE 16). Instead the chunk's set bits are mapped
+        to their candidate *values* once (:meth:`_chunk_primes`, LRU'd),
+        and every value landing in the chunk is answered by a single
+        ``np.searchsorted`` against that sorted array. Chunk keys stay
+        aligned from seg.lo exactly as in the scalar path, so the two
+        paths share LRU entries and deadline/demotion semantics (the
+        tick still fires inside :meth:`get_flags` before a fresh sieve).
+        """
+        seg = self.segments[sj]
+        base = int(self._prefix[sj - 1]) if sj else 0
+        totals = np.full(varr.size, base, dtype=np.int64)
+        for p in self.layout.extra_primes:  # extras_in(seg.lo, v), vectorized
+            if p >= seg.lo:
+                totals += varr > p
+        ci = (varr - seg.lo) // INDEX_CHUNK
+        vmax = int(varr.max())
+        running = 0  # popcount of full chunks already walked
+        for c, (clo, chi) in enumerate(self.chunks(seg.lo, seg.hi)):
+            if clo >= vmax:
+                break
+            pv = self._chunk_primes(clo, chi, ctx)
+            msk = ci == c
+            if bool(msk.any()):
+                totals[msk] += running + np.searchsorted(
+                    pv, varr[msk], side="left"
+                )
+                out[sel[msk]] = totals[msk]
+                ctx.answered_hi = max(ctx.answered_hi, int(varr[msk].max()))
+                ctx.count_so_far = max(ctx.count_so_far,
+                                       int(totals[msk].max()))
+            if chi <= vmax:  # later chunk still holds values: roll prefix
+                running += pv.size
+                ctx.answered_hi = max(ctx.answered_hi, chi)
+                ctx.count_so_far = max(
+                    ctx.count_so_far,
+                    base + self.layout.extras_in(seg.lo, chi) + running,
+                )
+
+    def _chunk_primes(self, clo: int, chi: int, ctx: QueryCtx) -> np.ndarray:
+        """Sorted prime values in chunk [clo, chi) (layout extras excluded):
+        the chunk's set bits mapped through ``values_np``. Cached in a
+        second LRU so a hot batch costs one searchsorted, not a popcount
+        walk; a hit here is an LRU hit for provenance purposes."""
+        pv = self._pv.get(clo, chi)
+        if pv is not None:
+            ctx.lru_hit = True
+            with self._stat_lock:
+                self.lru_hits += 1
+            return pv
+        flags = self.get_flags(clo, chi, ctx)
+        pv = self.layout.values_np(clo, np.flatnonzero(flags))
+        self._pv.put(clo, chi, pv)
+        return pv
 
     # --- selection -------------------------------------------------------
 

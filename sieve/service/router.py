@@ -76,7 +76,19 @@ from sieve.chaos import (
 from sieve.enumerate import MAX_HI
 from sieve.debug import FlightRecorder
 from sieve.metrics import MetricsHistory, MetricsLogger, registry
-from sieve.rpc import parse_addr, recv_msg, send_msg
+import numpy as np
+
+from sieve.rpc import (
+    SUPPORTED_WIRE,
+    WIRE_V1,
+    WIRE_V2,
+    BatchOutcomes,
+    batch_cols_to_items,
+    encode_msg,
+    encode_msg_v2,
+    parse_addr,
+    recv_msg,
+)
 from sieve.service.client import CallTimeout, ReplicaSet, ServiceError
 from sieve.service.server import BadRequest, DeadlineExceeded, Draining
 from sieve.service.shards import ShardMap
@@ -144,6 +156,10 @@ class RouterSettings:
     debug_dir: str | None = None
     debug_cooldown_s: float = 30.0
     metrics_sample_s: float = 1.0
+    # binary wire v2 (ISSUE 16): False makes this a v1-only router —
+    # hello answers ``wire: 1`` upstream AND the downstream shard legs
+    # skip negotiation (the mixed-fleet simulation knob)
+    wire_v2: bool = True
 
     def validate(self) -> "RouterSettings":
         for name in ("default_deadline_s", "timeout_s", "probe_timeout_s"):
@@ -244,6 +260,14 @@ class SieveRouter:
                 probe_timeout_s=s.probe_timeout_s,
                 rounds=s.rounds,
                 probe_ttl_s=s.probe_ttl_s,
+                # shard legs go columnar when both ends speak v2; a
+                # v1-only router never even offers (ISSUE 16).
+                # keep_arrays: decoded primes columns stay int64 arrays
+                # through _primes/_count_pairs and re-encode straight
+                # into this router's own reply columns — no JSON and no
+                # Python-int round trip anywhere on the path
+                negotiate=None if s.wire_v2 else False,
+                keep_arrays=True,
             )
             for sh in shardmap
         ]
@@ -707,7 +731,20 @@ class SieveRouter:
         members with a term on it — each gets a typed outcome tagged
         with the shard — while members on healthy shards still answer
         exactly."""
-        items = msg.get("items")
+        if "b_op" in msg:
+            # columnar v2 request (ISSUE 16): rebuild member dicts and
+            # run the ordinary planner — the router's work per member
+            # is routing, not decoding, so the dict form costs nothing
+            # extra here and the per-shard legs re-pack into columns
+            # anyway (each ReplicaSet client negotiates its own wire)
+            try:
+                items = batch_cols_to_items(
+                    msg["b_op"], msg["b_a"], msg["b_b"])
+            except (KeyError, TypeError, ValueError):
+                raise BadRequest(
+                    "batch: malformed b_op/b_a/b_b columns") from None
+        else:
+            items = msg.get("items")
         if not isinstance(items, list) or not items:
             raise BadRequest("batch: items must be a non-empty list")
         self._bump("batch_requests")
@@ -981,23 +1018,32 @@ class SieveRouter:
         raise AssertionError("unreachable: last shard handles any k")
 
     def _primes(self, lo: int, hi: int, deadline: float,
-                rctx: _RouteCtx) -> list[int]:
+                rctx: _RouteCtx) -> np.ndarray:
         lo = max(lo, 2)
         if hi <= lo:
-            return []
+            return np.zeros(0, dtype=np.int64)
         if lo < self.map.lo:
             raise BadRequest(
                 f"primes: lo={lo} below the fabric range "
                 f"[{self.map.lo}, ...)"
             )
-        out: list[int] = []
+        # shard legs deliver int64 arrays (keep_arrays clients decode
+        # the binary columns straight into them); v1 shards hand lists,
+        # normalized here once — member order is ascending by shard
+        parts: list[np.ndarray] = []
+        count = 0
         for i, a, b in self.map.shards_in(lo, hi):
-            vals = self._shard_query(i, "primes", deadline, rctx,
-                                     lo=a, hi=b)
-            out.extend(int(p) for p in vals)
+            vals = np.asarray(
+                self._shard_query(i, "primes", deadline, rctx,
+                                  lo=a, hi=b),
+                dtype=np.int64,
+            )
+            parts.append(vals)
+            count += int(vals.size)
             rctx.answered_hi = max(rctx.answered_hi, b)
-            rctx.count_so_far = len(out)
-        return out
+            rctx.count_so_far = count
+        return (np.concatenate(parts) if parts
+                else np.zeros(0, dtype=np.int64))
 
     # --- control plane ---------------------------------------------------
 
@@ -1076,6 +1122,9 @@ class SieveRouter:
         out["draining"] = self._draining
         out["probes"] = sum(rs.probes for rs in self.sets)
         out["failovers"] = sum(rs.failovers for rs in self.sets)
+        # ISSUE 16: shard connections that came up v1-only — a nonzero
+        # value on a supposedly all-v2 fleet is the downgrade signal
+        out["wire_downgrades"] = sum(rs.downgrades for rs in self.sets)
         return out
 
     # --- network plumbing ------------------------------------------------
@@ -1087,6 +1136,12 @@ class SieveRouter:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            try:
+                # hot RPC path: replies leave on send, not on the
+                # peer's delayed ACK (same knob as the shard server)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
             with self._conns_lock:
                 self._conns.add(conn)
             t = threading.Thread(
@@ -1096,6 +1151,12 @@ class SieveRouter:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         send_lock = threading.Lock()
+        # per-connection negotiated send version (ISSUE 16): a mutable
+        # cell rather than a conn attribute — this thread owns the conn,
+        # only the hello branch writes it
+        state = {"wire_v": WIRE_V1}  # guard: none(owned by this
+        # conn's serve thread; the hello branch is the only writer and
+        # runs on the same thread as every reader)
         try:
             while not self._closing:
                 try:
@@ -1104,7 +1165,7 @@ class SieveRouter:
                     return
                 if msg is None:
                     return
-                self._dispatch(conn, send_lock, msg)
+                self._dispatch(conn, send_lock, msg, state)
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
@@ -1114,16 +1175,37 @@ class SieveRouter:
                 pass
 
     def _reply(self, conn: socket.socket, send_lock: threading.Lock,
-               payload: dict) -> None:
+               payload: dict, cols: dict | None = None) -> None:
+        frame = (encode_msg_v2(payload, cols) if cols
+                 else encode_msg(payload))
         try:
             with send_lock:
-                send_msg(conn, payload)
+                conn.sendall(frame)
         except OSError:
             pass
 
-    def _dispatch(self, conn, send_lock, msg: dict) -> None:
+    def _dispatch(self, conn, send_lock, msg: dict, state: dict) -> None:
         mtype = msg.get("type")
         rid = msg.get("id")
+        if mtype == "hello":
+            # wire-version negotiation (ISSUE 16): same contract as the
+            # shard server — highest mutual version, v1 JSON floor. A
+            # wire_v2=False router answers 1, and its v2-capable caller
+            # logs the wire_downgrade.
+            try:
+                peer = {int(v) for v in (msg.get("wire") or ())
+                        if not isinstance(v, bool)}
+            except (TypeError, ValueError):
+                peer = set()
+            mine = set(SUPPORTED_WIRE) if self.settings.wire_v2 \
+                else {WIRE_V1}
+            mutual = peer & mine
+            state["wire_v"] = max(mutual) if mutual else WIRE_V1
+            self._reply(conn, send_lock,
+                        {"type": "hello", "id": rid, "ok": True,
+                         "wire": state["wire_v"],
+                         "versions": sorted(mine)})
+            return
         if mtype == "health":
             h = self.health()
             h["id"] = rid
@@ -1186,19 +1268,21 @@ class SieveRouter:
                 "detail": f"unknown message type {mtype!r}",
             })
             return
-        self._handle_query(conn, send_lock, msg, rid)
+        self._handle_query(conn, send_lock, msg, rid, state)
 
-    def _handle_query(self, conn, send_lock, msg: dict, rid) -> None:
+    def _handle_query(self, conn, send_lock, msg: dict, rid,
+                      state: dict) -> None:
         with self._inflight_lock:
             self._inflight_n += 1
         try:
-            self._handle_query_inner(conn, send_lock, msg, rid)
+            self._handle_query_inner(conn, send_lock, msg, rid, state)
         finally:
             with self._inflight_lock:
                 self._inflight_n -= 1
             self._maybe_drained()
 
-    def _handle_query_inner(self, conn, send_lock, msg: dict, rid) -> None:
+    def _handle_query_inner(self, conn, send_lock, msg: dict, rid,
+                            state: dict) -> None:
         op = str(msg.get("op", ""))
         t0 = trace.now_s()
         seq = self._next_seq()
@@ -1320,7 +1404,29 @@ class SieveRouter:
             "router_request", quietable=True, op=op, outcome=outcome,
             shards=len(rctx.shards), ms=reply["elapsed_ms"],
         )
-        self._reply(conn, send_lock, reply)
+        # reply finalization (ISSUE 16): array/batch values go out as v2
+        # columns on a negotiated connection — the shard legs already
+        # delivered them as arrays (keep_arrays), so a routed primes
+        # window is never JSON-encoded per element anywhere on its path
+        cols = None
+        val = reply.get("value")
+        if isinstance(val, np.ndarray):
+            if state["wire_v"] >= WIRE_V2:
+                del reply["value"]
+                # values column, not bitset words: the window spans
+                # shards whose packings may differ, and the router has
+                # no layout of its own to re-pack against
+                reply.update({"vkind": "primes", "prepr": "values"})
+                cols = {"p_vals": val.astype("<i8", copy=False)}
+            else:
+                reply["value"] = val.tolist()
+        elif (op == "batch" and isinstance(val, list)
+                and state["wire_v"] >= WIRE_V2):
+            bo = BatchOutcomes.from_items(val)
+            del reply["value"]
+            extra, cols = bo.wire()
+            reply.update(extra)
+        self._reply(conn, send_lock, reply, cols=cols)
 
 
 def _req_int(msg: dict, field: str) -> int:

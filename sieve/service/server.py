@@ -121,7 +121,18 @@ from sieve.checkpoint import (
 )
 from sieve.enumerate import MAX_HI, primes_in_range
 from sieve.metrics import MetricsHistory, MetricsLogger, registry, sample_interval_s
-from sieve.rpc import FrameDecoder, encode_msg, parse_addr
+from sieve.rpc import (
+    SUPPORTED_WIRE,
+    WIRE_V1,
+    WIRE_V2,
+    BatchOutcomes,
+    FrameDecoder,
+    batch_cols_to_items,
+    encode_msg,
+    encode_msg_v2,
+    parse_addr,
+    primes_to_cols,
+)
 from sieve.seed import seed_primes
 from sieve.service.index import QueryCtx, SieveIndex
 
@@ -293,6 +304,11 @@ class ServiceSettings:
     # stuck socket can never balloon the event loop's memory.
     batch_queries: int = 1024
     write_queue_bytes: int = 8 << 20
+    # binary wire v2 (ISSUE 16): answer ``hello`` negotiation with the
+    # columnar frame capability. False pins every connection to v1 JSON
+    # — the mixed-fleet simulation knob and the emergency off-switch;
+    # clients detect the downgrade and log one ``wire_downgrade`` event.
+    wire_v2: bool = True
 
     def validate(self) -> "ServiceSettings":
         """Typed startup validation: every rejection names the setting
@@ -409,6 +425,7 @@ class ServiceSettings:
             refresh_s=_env_float("SIEVE_SVC_REFRESH_S", cls.refresh_s),
             drain_s=_env_float("SIEVE_SVC_DRAIN_S", cls.drain_s),
             wire_chaos=_env_bool("SIEVE_SVC_WIRE_CHAOS", "0"),
+            wire_v2=_env_bool("SIEVE_SVC_WIRE_V2", "1"),
             cold_delay_s=_env_float("SIEVE_SVC_COLD_DELAY_S", cls.cold_delay_s),
             persist_cold=_env_bool("SIEVE_SVC_PERSIST_COLD", "0"),
             batch_max_chunks=_env_int(
@@ -880,6 +897,7 @@ _STATS = (
     "batch_requests",
     "batch_members",
     "slow_consumer_closed",
+    "wire_v2_conns",
 )
 
 
@@ -905,7 +923,7 @@ class _Conn:
 
     __slots__ = ("sock", "decoder", "wq", "head_off", "wq_bytes", "lock",
                  "tx", "sending", "closed", "kill", "throttle_bps",
-                 "next_t", "mask")
+                 "next_t", "mask", "wire_v")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -933,6 +951,12 @@ class _Conn:
         # budget, both safe)
         self.next_t = 0.0
         self.mask = 0  # selector interest currently registered
+        # negotiated wire version for frames WE send on this conn
+        # (ISSUE 16). Starts at the v1 JSON floor; the hello handshake
+        # raises it before the client pipelines its first v2-era query.
+        self.wire_v = WIRE_V1  # guard: none(written once by the wire
+        # thread on hello, strictly before any reply that could observe
+        # it is enqueued; workers only ever read)
 
     def pending(self) -> bool:
         with self.lock:
@@ -1537,6 +1561,12 @@ class SieveService:
                     pass
                 return False
             sock.setblocking(False)
+            try:
+                # hot RPC path: a multi-segment reply must not sit in
+                # the Nagle buffer waiting on the peer's delayed ACK
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass  # non-TCP transports (tests) have no such knob
             c = _Conn(sock)
             with self._conns_lock:
                 self._conns.add(c)
@@ -1587,7 +1617,10 @@ class SieveService:
                         head = c.wq[0]
                         off = c.head_off
                         c.sending = True
-                    chunk = head[off:]
+                    # memoryview slices: resuming a partially-sent frame
+                    # (and budget-capping a throttled one) must not copy
+                    # the frame tail on every send() round (ISSUE 16)
+                    chunk = memoryview(head)[off:]
                     if budget is not None:
                         if budget <= 0:
                             return True
@@ -1644,7 +1677,8 @@ class SieveService:
         except OSError:
             pass
 
-    def _reply(self, c: _Conn, payload: dict, front: bool = False) -> None:
+    def _reply(self, c: _Conn, payload: dict, front: bool = False,
+               cols: dict | None = None) -> None:
         """Enqueue one encoded reply frame on the connection's bounded
         write queue and wake the loop. ``front=True`` (inline ops) jumps
         ahead of queued query replies — but never into the middle of a
@@ -1659,8 +1693,11 @@ class SieveService:
         wake-byte + selector-thread context switch costs more than the
         reply itself, and an idle-queue conn has no in-flight partial
         frame a direct send could interleave with (``tx`` guarantees
-        it even against a racing loop flush)."""
-        frame = encode_msg(payload)
+        it even against a racing loop flush).
+
+        ``cols`` ships the payload as a v2 columnar frame (ISSUE 16) —
+        callers pass it only on connections that negotiated v2."""
+        frame = encode_msg_v2(payload, cols) if cols else encode_msg(payload)
         overflow = False
         direct = False
         queued = 0
@@ -1725,6 +1762,29 @@ class SieveService:
             self._reply(conn,
                         {"type": "stats", "id": rid, "ok": True,
                          "stats": self.stats()}, front=True)
+            return None
+        if mtype == "hello":
+            # wire-version negotiation (ISSUE 16): intersect the peer's
+            # advertised versions with ours, highest mutual wins, v1
+            # JSON is the floor. Answered inline BEFORE any pipelined
+            # query reply, so the client knows the encoding of
+            # everything that follows. Decoding is capability-based
+            # (frames are self-describing) — negotiation only governs
+            # what each side sends.
+            try:
+                peer = {int(v) for v in (msg.get("wire") or ())
+                        if not isinstance(v, bool)}
+            except (TypeError, ValueError):
+                peer = set()
+            mine = set(SUPPORTED_WIRE) if self.settings.wire_v2 \
+                else {WIRE_V1}
+            mutual = peer & mine
+            conn.wire_v = max(mutual) if mutual else WIRE_V1
+            if conn.wire_v >= WIRE_V2:
+                self._bump("wire_v2_conns")
+            self._reply(conn, {"type": "hello", "id": rid, "ok": True,
+                               "wire": conn.wire_v,
+                               "versions": sorted(mine)}, front=True)
             return None
         if mtype == "shutdown":
             # rolling-restart control message: same path as SIGTERM
@@ -1946,6 +2006,20 @@ class SieveService:
                     return "hot"
                 return "hot" if hi <= idx.covered_hi else "cold"
             if op == "batch":
+                if "b_op" in msg:
+                    # columnar batch (ISSUE 16): one vectorized bound
+                    # check instead of a member loop. The max over both
+                    # argument columns (+1 for the pi/is_prime prefix)
+                    # over-approximates every needed prefix; anything
+                    # malformed classifies hot (typed bad_request).
+                    b_a, b_b = msg.get("b_a"), msg.get("b_b")
+                    try:
+                        if b_a.size == 0:
+                            return "hot"
+                        top = max(int(b_a.max()) + 1, int(b_b.max()))
+                    except (AttributeError, TypeError, ValueError):
+                        return "hot"
+                    return self._lane_for_prefixes([top], idx)
                 items = msg.get("items")
                 if (not isinstance(items, list) or not items
                         or len(items) > self.settings.batch_queries):
@@ -2152,8 +2226,29 @@ class SieveService:
                 self._bump("telemetry_replies")
         if msg.get("t_send") is not None:
             reply["t_sent"] = round(trace.now_s(), 6)
+        # reply finalization (ISSUE 16): array-shaped values become v2
+        # columns on a negotiated connection, or plain JSON lists on v1
+        # — the op handlers above never branch on the wire version
+        cols = None
+        val = reply.get("value")
+        if isinstance(val, BatchOutcomes):
+            if conn.wire_v >= WIRE_V2:
+                del reply["value"]
+                extra, cols = val.wire()
+                reply.update(extra)
+            else:
+                reply["value"] = val.to_items()
+        elif isinstance(val, np.ndarray):
+            if conn.wire_v >= WIRE_V2:
+                del reply["value"]
+                extra, cols = primes_to_cols(val, self.config.packing,
+                                             int(msg.get("lo", 0)),
+                                             int(msg.get("hi", 0)))
+                reply.update(extra)
+            else:
+                reply["value"] = val.tolist()
         try:
-            self._reply(conn, reply)
+            self._reply(conn, reply, cols=cols)
         finally:
             # drain accounting: this admitted query is now answered
             with self._inflight_lock:
@@ -2211,11 +2306,91 @@ class SieveService:
                 self._check_base(op, lo)
             return self._primes(lo, hi, ctx, deadline, idx)
         if op == "batch":
+            if "b_op" in msg:
+                return self._execute_batch_cols(msg, ctx, deadline, idx)
             return self._execute_batch(msg, ctx, deadline, idx)
         raise BadRequest(
             f"unknown op {op!r} (one of pi, is_prime, count, nth_prime, "
             "primes, batch)"
         )
+
+    def _execute_batch_cols(self, msg: dict, ctx: QueryCtx, deadline: float,
+                            idx: SieveIndex) -> BatchOutcomes:
+        """Columnar batch fast path (ISSUE 16): validate and answer M
+        members with pure array ops — zero per-member Python objects.
+
+        The request arrives as ``b_op``/``b_a``/``b_b`` columns (see
+        :func:`sieve.rpc.batch_items_to_cols`). When every member is
+        well-formed and every needed prefix is inside the index, the
+        whole batch is: dedup -> one ``count_upto_batch`` row -> three
+        masked gathers. ANY deviation — unknown opcode, bound
+        violation, shard-base issue, a cold value — rebuilds the member
+        dicts and delegates to :meth:`_execute_batch`, which owns the
+        typed per-member outcome semantics (and ``_Demoted``); the fast
+        path never re-implements an error message."""
+        b_op, b_a, b_b = msg.get("b_op"), msg.get("b_a"), msg.get("b_b")
+        if (not isinstance(b_op, np.ndarray) or not isinstance(b_a, np.ndarray)
+                or not isinstance(b_b, np.ndarray)
+                or not (b_op.size == b_a.size == b_b.size)):
+            raise BadRequest("batch: malformed column payload")
+        m = int(b_op.size)
+        if m == 0:
+            raise BadRequest("batch: items must be a non-empty list")
+        if m > self.settings.batch_queries:
+            raise BadRequest(
+                f"batch: {m} members exceed "
+                f"batch_queries={self.settings.batch_queries}"
+            )
+        ops = b_op.astype(np.int64)
+        a = b_a.astype(np.int64)
+        b = b_b.astype(np.int64)
+        pi_m = ops == 0
+        ip_m = ops == 1
+        ct_m = ops == 2
+        fast = bool(
+            (pi_m | ip_m | ct_m).all()
+            and not (pi_m.any() and self.base > 2)
+            and not (a[pi_m] < 0).any()
+            # spelled >= MAX_HI (not +1 > MAX_HI): x+1 on an int64 max
+            # would wrap negative and sneak past the guard
+            and not (a[pi_m | ip_m] >= MAX_HI).any()
+            and not (b[ct_m] > MAX_HI).any()
+            and not (b[ct_m] < a[ct_m]).any()
+        )
+        if fast and self.base > 2:
+            # shard server: scalar paths typed-reject members below the
+            # shard base (is_prime keeps the x<2 -> False carve-out)
+            if ((ip_m & (a >= 2) & (a < self.base))
+                    | (ct_m & (b > a) & (a < self.base))).any():
+                fast = False
+        if fast:
+            needed = np.concatenate(
+                (a[pi_m] + 1, a[ip_m], a[ip_m] + 1, a[ct_m], b[ct_m])
+            )
+            if needed.size and int(needed.max()) > idx.covered_hi:
+                fast = False  # a cold prefix: the member loop owns it
+        if not fast:
+            sub = dict(msg)
+            sub["items"] = batch_cols_to_items(b_op, b_a, b_b)
+            return BatchOutcomes.from_items(
+                self._execute_batch(sub, ctx, deadline, idx)
+            )
+        self._bump("batch_requests")
+        self._bump("batch_members", m)
+        uniq = np.unique(needed)
+        resolved = np.zeros(uniq.size, dtype=np.int64)
+        hot = uniq > self.base  # <= base resolves to 0 by definition
+        if hot.any():
+            resolved[hot] = idx.count_upto_batch(uniq[hot], ctx)
+
+        def pref(vs: np.ndarray) -> np.ndarray:
+            return resolved[np.searchsorted(uniq, vs)]
+
+        val = np.zeros(m, dtype=np.int64)
+        val[pi_m] = pref(a[pi_m] + 1)
+        val[ip_m] = pref(a[ip_m] + 1) - pref(a[ip_m]) > 0
+        val[ct_m] = pref(b[ct_m]) - pref(a[ct_m])
+        return BatchOutcomes(np.ones(m, dtype=np.uint8), val, {}, b_op)
 
     def _execute_batch(self, msg: dict, ctx: QueryCtx, deadline: float,
                        idx: SieveIndex) -> list[dict]:
@@ -2439,14 +2614,16 @@ class SieveService:
         return int(layout.values_np(lo, np.array([pos]))[0])
 
     def _primes(self, lo: int, hi: int, ctx: QueryCtx,
-                deadline: float, idx: SieveIndex) -> list[int]:
+                deadline: float, idx: SieveIndex) -> np.ndarray:
         if hi > MAX_HI:
             raise BadRequest(f"primes: hi={hi} exceeds {MAX_HI}")
         if hi < lo:
             raise BadRequest(f"primes: hi={hi} < lo={lo}")
-        a = self._collect_primes(lo, hi, ctx, deadline,
-                                 cap=self.settings.max_primes, idx=idx)
-        return [int(p) for p in a]
+        # stays an int64 array: a v2 connection ships it as raw bitset
+        # words or a packed column, a v1 connection gets .tolist() at
+        # reply-encode time — either way, no per-element work here
+        return self._collect_primes(lo, hi, ctx, deadline,
+                                    cap=self.settings.max_primes, idx=idx)
 
     def _collect_primes(self, lo: int, hi: int, ctx: QueryCtx,
                         deadline: float, cap: int | None,

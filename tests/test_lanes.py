@@ -173,7 +173,7 @@ def test_health_and_stats_key_schema_snapshot(service):
         "refresh_attempts", "refresh_failed", "refreshes", "requests",
         "segments", "shed", "slo", "slow_consumer_closed",
         "snapshot_age_s", "telemetry_replies",
-        "total_primes", "trace_drops",
+        "total_primes", "trace_drops", "wire_v2_conns",
     ]
 
 

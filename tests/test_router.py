@@ -519,7 +519,7 @@ def test_router_health_and_stats_key_schema_snapshot(src_dirs, tmp_path):
             "scattered", "shard_count", "shard_down_windows",
             "shard_errors", "shed_relayed", "spliced",
             "telemetry_events", "telemetry_gaps", "telemetry_merged",
-            "totals_cached", "unavailable_replies",
+            "totals_cached", "unavailable_replies", "wire_downgrades",
         ]
         # a downed shard degrades fabric health and breaks contiguity
         f.svcs[1].stop()

@@ -29,7 +29,8 @@ from sieve.checkpoint import Ledger
 from sieve.config import SieveConfig
 from sieve.coordinator import run_local
 from sieve.metrics import MemorySink, validate_record
-from sieve.rpc import MAX_FRAME, FrameDecoder, encode_msg, recv_msg
+from sieve.rpc import (MAX_FRAME, FrameDecoder, encode_msg, encode_msg_v2,
+                       recv_msg)
 from sieve.seed import seed_primes
 from sieve.service import (
     ClientPool,
@@ -356,7 +357,10 @@ def test_slow_consumer_overflowing_write_queue_is_killed(
     # the server closes the connection instead of buffering unboundedly
     with SieveService(_cfg(str(ledger_dir)),
                       _settings(write_queue_bytes=4096)) as svc:
-        with ServiceClient(svc.addr, timeout_s=30) as cli:
+        # v1 JSON on purpose: the binary bitset reply for this window is
+        # ~1 KB and would drain fine — the kill needs the 23 KB text form
+        with ServiceClient(svc.addr, timeout_s=30,
+                           negotiate=False) as cli:
             with pytest.raises((ConnectionError, OSError)):
                 cli.primes(2, 30_000)  # ~23 KB reply > 4 KB queue
         with ServiceClient(svc.addr, timeout_s=30) as cli2:
@@ -590,7 +594,7 @@ class _Fabric:
     """Two-shard in-process fabric (split 2+2 segments at E)."""
 
     def __init__(self, ledger_dir, tmp_path, shard1_dead=False,
-                 router_settings=None):
+                 router_settings=None, shard_settings=None):
         segs = sorted(
             Ledger.open_readonly(_cfg(str(ledger_dir)))
             .completed().values(),
@@ -602,15 +606,16 @@ class _Fabric:
             led = Ledger.open(_cfg(str(d)))
             for r in part:
                 led.record(r)
+        skw = dict(shard_settings or {})
         self.svcs = [
-            SieveService(_cfg(str(dirs[0])), _settings()).start()
+            SieveService(_cfg(str(dirs[0])), _settings(**skw)).start()
         ]
         if shard1_dead:
             s1_addrs = (_dead_addr(),)
         else:
             self.svcs.append(
                 SieveService(_cfg(str(dirs[1])),
-                             _settings(range_lo=self.E)).start()
+                             _settings(range_lo=self.E, **skw)).start()
             )
             s1_addrs = (self.svcs[1].addr,)
         self.map = ShardMap([
@@ -736,3 +741,230 @@ def test_bench_compare_gates_qps_regressions():
     assert regressions == []
     _lines, regressions = compare(rec(50_000.0), rec(65_000.0), 0.10)
     assert regressions == []
+
+
+def test_bench_compare_gates_wire_bytes_ceiling_and_growth():
+    from tools.bench_compare import compare
+
+    def rec(v):
+        return {"service_wire_bytes_per_member": {
+            "metric": "service_wire_bytes_per_member", "value": v,
+            "unit": "bytes_per_member"}}
+
+    # absolute ceiling fires even on a metric's first round
+    _lines, regressions = compare({}, rec(70.0), 0.10)
+    assert regressions and "48" in regressions[0]
+    _lines, regressions = compare({}, rec(27.0), 0.10)
+    assert regressions == []
+    # round-over-round: lower is better, gate on increases
+    _lines, regressions = compare(rec(27.0), rec(33.0), 0.10)
+    assert regressions and "bytes/member" in regressions[0]
+    _lines, regressions = compare(rec(27.0), rec(26.0), 0.10)
+    assert regressions == []
+
+
+# --- binary wire v2 (ISSUE 16) -----------------------------------------------
+
+
+def _decoded_equal(got: dict, want_msg: dict, want_cols: dict) -> None:
+    """A decoded v2 frame carries the header fields verbatim plus one
+    ndarray per manifest column (and the manifest itself)."""
+    for k, v in want_msg.items():
+        assert got[k] == v, k
+    assert [e[0] for e in got["_cols"]] == list(want_cols)
+    for name, arr in want_cols.items():
+        assert np.array_equal(got[name], np.asarray(arr)), name
+
+
+def test_frame_decoder_v2_interleaved_byte_by_byte():
+    """v1 and v2 frames interleaved on one connection, delivered one
+    byte at a time: every frame decodes, in order, at the exact byte
+    that completes it."""
+    j1 = {"type": "query", "op": "pi", "x": 10**9, "id": 1}
+    m2 = {"type": "query", "op": "batch", "id": 2}
+    c2 = {"b_op": np.array([0, 1, 2], np.uint8),
+          "b_a": np.array([10, 97, -5], np.int64),
+          "b_b": np.array([0, 0, 50], np.int64)}
+    j3 = {"type": "health", "id": 3}
+    m4 = {"type": "reply", "id": 4, "ok": True, "vkind": "primes",
+          "prepr": "values"}
+    c4 = {"p_vals": np.arange(257, dtype=np.int64) * 3 + 2}
+    m5 = {"type": "reply", "id": 5, "ok": True}  # v2 body, zero columns
+    c5 = {"r_ok": np.zeros(0, np.uint8)}
+    wire = (encode_msg(j1) + encode_msg_v2(m2, c2) + encode_msg(j3)
+            + encode_msg_v2(m4, c4) + encode_msg_v2(m5, c5))
+    dec = FrameDecoder()
+    got = []
+    for i in range(len(wire)):
+        got.extend(dec.feed(wire[i:i + 1]))
+    assert dec.buffered() == 0
+    assert len(got) == 5
+    assert got[0] == j1 and got[2] == j3
+    _decoded_equal(got[1], m2, c2)
+    _decoded_equal(got[3], m4, c4)
+    _decoded_equal(got[4], m5, c5)
+
+
+def test_frame_decoder_v2_split_frames_keep_zero_copy_views():
+    # a frame assembled from fragments still yields real int64 columns
+    frame = encode_msg_v2({"type": "reply", "id": 9, "ok": True},
+                          {"p_vals": np.array([2, 3, 5, 7], np.int64)})
+    for cut in (1, 8, 9, 12, len(frame) - 1):
+        dec = FrameDecoder()
+        assert dec.feed(frame[:cut]) == []
+        (msg,) = dec.feed(frame[cut:])
+        assert msg["p_vals"].tolist() == [2, 3, 5, 7]
+        assert dec.buffered() == 0
+
+
+def _v2_body_frame(body: bytes) -> bytes:
+    return len(body).to_bytes(8, "big") + body
+
+
+def test_frame_decoder_v2_truncated_and_malformed_bodies_are_typed():
+    import json as _json
+    import struct as _struct
+
+    def hdr(obj) -> bytes:
+        blob = _json.dumps(obj).encode()
+        return b"\x02" + _struct.pack("<I", len(blob)) + blob
+
+    bad_bodies = [
+        b"\x02",                                   # nothing after magic
+        b"\x02\xff\xff",                           # truncated header len
+        b"\x02" + _struct.pack("<I", 99) + b"{}",  # header overruns frame
+        hdr([1, 2, 3]),                            # header not an object
+        hdr({"_cols": {"not": "a list"}}),         # manifest not a list
+        hdr({"_cols": [["x", "<i8"]]}),            # entry missing count
+        hdr({"_cols": [["x", ">i8", 1]]}) + b"\0" * 8,   # big-endian dtype
+        hdr({"_cols": [["x", "<i8", True]]}) + b"\0" * 8,  # bool count
+        hdr({"_cols": [["x", "<i8", -1]]}),        # negative count
+        hdr({"_cols": [["x", "<i8", 4]]}) + b"\0" * 8,   # column overrun
+        hdr({"_cols": [["x", "<i8", 1]]}) + b"\0" * 16,  # trailing bytes
+    ]
+    for body in bad_bodies:
+        with pytest.raises(ValueError):
+            FrameDecoder().feed(_v2_body_frame(body))
+
+
+def test_frame_decoder_v2_oversized_header_hits_max_frame():
+    # a v2 frame whose length prefix exceeds MAX_FRAME is refused at
+    # the prefix, before any column header is even parsed — exactly
+    # the JSON garbage-prefix rule
+    prefix = (MAX_FRAME + 1).to_bytes(8, "big") + b"\x02"
+    with pytest.raises(ValueError, match="frame"):
+        FrameDecoder().feed(prefix)
+
+
+def test_wire_negotiation_picks_highest_mutual(ledger_dir):
+    with SieveService(_cfg(str(ledger_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as v2, \
+                ServiceClient(svc.addr, timeout_s=30,
+                              negotiate=False) as v1:
+            assert v2.wire_v == 2 and not v2.downgraded
+            assert v1.wire_v == 1
+            assert svc.stats()["wire_v2_conns"] == 1
+            # both speak to the same server, both stay exact
+            for x in (2, 97, 30_000):
+                assert v1.query("pi", x=x)["value"] == o_pi(x)
+                assert v2.query("pi", x=x)["value"] == o_pi(x)
+
+
+def test_wire_downgrade_is_logged_not_silent(ledger_dir, memsink):
+    """A v2-capable client landing on a v1-pinned server emits exactly
+    one schema-valid wire_downgrade event and flags itself."""
+    with SieveService(_cfg(str(ledger_dir)),
+                      _settings(wire_v2=False)) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as cli:
+            assert cli.wire_v == 1 and cli.downgraded
+            assert cli.query("pi", x=97)["value"] == o_pi(97)
+            assert svc.stats()["wire_v2_conns"] == 0
+    events = [r for r in memsink.records if r["event"] == "wire_downgrade"]
+    assert len(events) == 1
+    validate_record(events[0])
+    assert events[0]["negotiated"] == 1
+
+
+def test_dual_encoding_parity_primes_both_reprs(ledger_dir):
+    """v1 JSON vs v2 binary primes replies are value-identical for both
+    v2 payload shapes (values column and wheel bitset words)."""
+    with SieveService(_cfg(str(ledger_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=30,
+                           negotiate=False) as v1, \
+                ServiceClient(svc.addr, timeout_s=30) as v2:
+            # tiny window -> values column; wide window -> bitset words
+            for lo, hi in ((0, 30), (17, 18), (40_000, 40_001),
+                           (2, 20_000), (25_000, 50_000)):
+                a = v1.query("primes", lo=lo, hi=hi)["value"]
+                b = v2.query("primes", lo=lo, hi=hi)["value"]
+                assert a == b, (lo, hi)
+                assert a == [int(p) for p in P
+                             if max(lo, 2) <= p < hi], (lo, hi)
+
+
+def test_dual_encoding_parity_batch_typed_members(ledger_dir):
+    with SieveService(_cfg(str(ledger_dir)), _settings()) as svc:
+        items = [
+            {"op": "pi", "x": 30_000},
+            {"op": "is_prime", "x": 12_347},
+            {"op": "count", "lo": 100, "hi": 20_000, "kind": "primes"},
+            {"op": "count", "lo": 20_000, "hi": 100, "kind": "primes"},
+            {"op": "pi", "x": "nope"},
+            {"op": "nosuch"},
+            {"op": "is_prime", "x": 4},
+        ]
+        with ServiceClient(svc.addr, timeout_s=30,
+                           negotiate=False) as v1, \
+                ServiceClient(svc.addr, timeout_s=30) as v2:
+            a = v1.query_batch(items)
+            b = v2.query_batch(items)
+            assert a == b
+            assert b[0]["value"] == o_pi(30_000)
+            assert b[1]["value"] is True and b[6]["value"] is False
+            assert b[3]["ok"] is False and b[4]["ok"] is False
+            assert b[5]["ok"] is False
+
+
+def _assert_fleet_exact(f):
+    for x in (100, f.E + 5_000, 1):
+        assert f.cli.query("pi", x=x)["value"] == o_pi(x)
+    got = f.cli.query("primes", lo=f.E - 500, hi=f.E + 500)["value"]
+    assert got == [int(p) for p in P if f.E - 500 <= p < f.E + 500]
+    items = [
+        {"op": "pi", "x": 100},
+        {"op": "count", "lo": 100, "hi": f.E + 200, "kind": "primes"},
+        {"op": "count", "lo": 900, "hi": 100, "kind": "primes"},
+        {"op": "is_prime", "x": 12_347},
+    ]
+    out = f.cli.query_batch(items)
+    assert out[0]["value"] == o_pi(100)
+    assert out[1]["value"] == o_count(100, f.E + 200)
+    assert out[2]["ok"] is False
+    assert out[3]["value"] is o_is_prime(12_347)
+
+
+def test_mixed_fleet_v1_router_v2_shards(ledger_dir, tmp_path):
+    """A v1-pinned router in front of v2 shards: its shard legs stay
+    JSON, its own clients get downgraded — answers stay exact."""
+    with _Fabric(ledger_dir, tmp_path,
+                 router_settings=RouterSettings(quiet=True,
+                                                wire_v2=False)) as f:
+        assert f.cli.wire_v == 1 and f.cli.downgraded
+        _assert_fleet_exact(f)
+
+
+def test_mixed_fleet_v2_router_v1_shards(ledger_dir, tmp_path):
+    """v1-pinned shards behind a v2 router: the shard legs downgrade
+    (counted in router stats), the client leg still speaks binary."""
+    with _Fabric(ledger_dir, tmp_path,
+                 shard_settings={"wire_v2": False}) as f:
+        assert f.cli.wire_v == 2 and not f.cli.downgraded
+        _assert_fleet_exact(f)
+        assert f.cli.stats()["wire_downgrades"] >= 1
+
+
+def test_all_v2_fleet_no_downgrades(ledger_dir, tmp_path):
+    with _Fabric(ledger_dir, tmp_path) as f:
+        assert f.cli.wire_v == 2
+        _assert_fleet_exact(f)
+        assert f.cli.stats()["wire_downgrades"] == 0

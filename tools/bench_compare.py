@@ -14,8 +14,11 @@ metric (``unit == "ms_p95"``) *increased* by more than the same
 threshold (lower is better — the service p95 gate, ISSUE 9), when any
 ``unit == "overhead_ratio"`` metric exceeds the ABSOLUTE 1.05 ceiling
 (the fleet-tracing <=5% budget, ISSUE 12 — applied even to a metric's
-first round, since the ceiling needs no baseline), or when the newest
-round itself failed (``rc != 0`` / ``ok == false``).
+first round, since the ceiling needs no baseline), when any
+``unit == "bytes_per_member"`` metric exceeds its absolute wire-cost
+ceiling or grows past the threshold round-over-round (the binary frame
+budget, ISSUE 16), or when the newest round itself failed
+(``rc != 0`` / ``ok == false``).
 
 Round order comes from the ``_r<NN>`` filename suffix, NOT mtime — a
 re-checkout or ``touch`` must not reorder history.
@@ -42,6 +45,12 @@ _DEFAULT_OVERHEAD_CEILING = 1.05
 _OVERHEAD_CEILINGS = {
     "service_lock_debug_overhead_ratio": 1.10,
 }
+# absolute wire-cost budgets (ISSUE 16): a ``bytes_per_member`` metric
+# must stay under its ceiling regardless of history — the binary batch
+# encoding measures ~27 B/member (17 sent + 9 received) vs ~70 for
+# JSON, so 48 flags any drift back toward text-sized frames.
+_DEFAULT_BYTES_CEILING = 48.0
+_BYTES_CEILINGS: dict[str, float] = {}
 
 
 def find_rounds(bench_dir: str, prefix: str) -> list[tuple[int, str]]:
@@ -102,6 +111,18 @@ def compare(
                 f"REGRESSION (> {ceiling} absolute ceiling)"
             )
             continue
+        bceiling = _BYTES_CEILINGS.get(name, _DEFAULT_BYTES_CEILING)
+        if n is not None and n.get("unit") == "bytes_per_member" \
+                and float(n["value"]) > bceiling:
+            regressions.append(
+                f"{name}: {float(n['value']):.4g} exceeds the absolute "
+                f"{bceiling} bytes/member ceiling"
+            )
+            lines.append(
+                f"  {name}: {float(n['value']):.4g} bytes_per_member  "
+                f"REGRESSION (> {bceiling} absolute ceiling)"
+            )
+            continue
         if o is None:
             # a metric present only in the newest round is reported
             # explicitly (it becomes next round's baseline), never
@@ -138,6 +159,13 @@ def compare(
             verdict = f"  REGRESSION (> {threshold:.0%} p95 increase)"
             regressions.append(
                 f"{name}: p95 {ov:.4g} ms -> {nv:.4g} ms ({delta:+.1%})"
+            )
+        elif unit == "bytes_per_member" and delta > threshold:
+            # wire cost (ISSUE 16): lower is better, gate on increases
+            # (on top of the absolute ceiling above)
+            verdict = f"  REGRESSION (> {threshold:.0%} wire-cost increase)"
+            regressions.append(
+                f"{name}: {ov:.4g} -> {nv:.4g} bytes/member ({delta:+.1%})"
             )
         lines.append(
             f"  {name}: {ov:.4g} -> {nv:.4g} {unit} "

@@ -16,8 +16,12 @@ regex-harvests every literal op (``op == "..."``) and message type
   next to the constant);
 * the ``batch`` op (ISSUE 14) appears on BOTH sides.
 
-Importable (``from tools.check_wire_ops import check``) so the tier-1
-suite runs it; ``main`` prints the verdict for CI / hook use.
+Binary wire v2 (ISSUE 16) adds a LIVE leg: ``check_encodings`` boots a
+tiny in-process service and replays every query op through a v1 (JSON)
+and a v2 (binary columns) client, asserting the decoded results are
+byte-for-byte identical under canonical JSON — the codec cannot change
+an answer, only its framing. ``check`` itself stays static (the tier-1
+suite imports it); ``main`` runs both legs for CI / hook use.
 """
 
 from __future__ import annotations
@@ -81,18 +85,99 @@ def check() -> list[str]:
     return problems
 
 
+#: every query op, exercised with both a success and (where the op can
+#: fail per-request) an error-shaped call — the live parity leg replays
+#: each through both encodings
+_ENCODING_PROBES: tuple[dict, ...] = (
+    {"op": "pi", "x": 2},
+    {"op": "pi", "x": 97},
+    {"op": "pi", "x": 1_999},
+    {"op": "is_prime", "x": 2},
+    {"op": "is_prime", "x": 91},
+    {"op": "count", "lo": 10, "hi": 1_500, "kind": "primes"},
+    {"op": "count", "lo": 10, "hi": 1_500, "kind": "twins"},
+    {"op": "count", "lo": 900, "hi": 10, "kind": "primes"},  # error
+    {"op": "nth_prime", "k": 25},
+    {"op": "primes", "lo": 0, "hi": 64},
+    {"op": "primes", "lo": 100, "hi": 1_900},
+    {"op": "primes", "lo": 1_999, "hi": 2_000},
+    {"op": "nosuch"},  # error
+)
+
+
+def _strip(reply: dict) -> dict:
+    """Drop per-call noise (timings, trace ids) before comparison."""
+    return {k: v for k, v in reply.items()
+            if k not in ("id", "elapsed_ms", "t_recv", "t_sent")}
+
+
+def check_encodings() -> list[str]:
+    """Live parity: every op through v1 JSON and v2 binary must decode
+    to identical results (and the batch of all probes member-for-member
+    too). Returns mismatches; empty list means the codec is neutral."""
+    import json
+    import tempfile
+
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+    from sieve.service import ServiceClient, ServiceSettings, SieveService
+
+    problems: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="wire_enc_") as tmp:
+        cfg = SieveConfig(n=2_000, backend="cpu-numpy", packing="wheel30",
+                          n_segments=2, quiet=True, checkpoint_dir=tmp)
+        run_local(cfg)
+        settings = ServiceSettings(workers=2, queue_limit=16,
+                                   default_deadline_s=10.0, refresh_s=0.0)
+        with SieveService(cfg, settings) as svc:
+            with ServiceClient(svc.addr, timeout_s=30,
+                               negotiate=False) as v1, \
+                    ServiceClient(svc.addr, timeout_s=30,
+                                  negotiate=True) as v2:
+                if v2.wire_v < 2:
+                    return ["v2 client failed to negotiate binary "
+                            f"framing (got wire_v={v2.wire_v})"]
+                for probe in _ENCODING_PROBES:
+                    a = _strip(v1.query(**probe))
+                    b = _strip(v2.query(**probe))
+                    if json.dumps(a, sort_keys=True) != \
+                            json.dumps(b, sort_keys=True):
+                        problems.append(
+                            f"encoding divergence on {probe!r}: "
+                            f"v1={a!r} v2={b!r}"
+                        )
+                items = [dict(p) for p in _ENCODING_PROBES]
+                ba = v1.query_batch(items)
+                bb = v2.query_batch(items)
+                if json.dumps(ba, sort_keys=True) != \
+                        json.dumps(bb, sort_keys=True):
+                    problems.append(
+                        f"encoding divergence on the batch op: "
+                        f"v1={ba!r} v2={bb!r}"
+                    )
+    return problems
+
+
 def main(argv: list[str] | None = None) -> int:
     problems = check()
+    static_n = len(problems)
+    if not problems:
+        # only bother booting the live service when the static surface
+        # is coherent — a drift would fail the replay anyway
+        problems = check_encodings()
     for p in problems:
         print(f"check_wire_ops: {p}", file=sys.stderr)
     if problems:
-        print(f"check_wire_ops: FAILED ({len(problems)} drift(s))",
+        print(f"check_wire_ops: FAILED ({len(problems)} "
+              f"{'drift(s)' if static_n else 'encoding mismatch(es)'})",
               file=sys.stderr)
         return 1
     server_ops, server_types = harvest(SERVER_PY)
     print(
         f"check_wire_ops: ok ({len(server_ops)} ops, "
-        f"{len(server_types)} message types in parity)"
+        f"{len(server_types)} message types in parity; "
+        f"{len(_ENCODING_PROBES)} probes + batch byte-identical "
+        "under v1 JSON and v2 binary)"
     )
     return 0
 
