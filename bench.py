@@ -551,6 +551,121 @@ def service_hot_qps_metric() -> None:
     )
 
 
+def service_hot_qps_scaling_metric() -> None:
+    """Multi-process scaling metric (ISSUE 17): hot qps at --procs 1, 2
+    and 4 on ONE port, all processes sharing the mmap'd segment store.
+
+    Python threads share one GIL, so the single-process hot ceiling is
+    roughly one core; SO_REUSEPORT processes are the escape hatch. The
+    recorded value is the incremental efficiency q4 / (4 * q1) — gated
+    by tools/bench_compare.py's ``scaling_ratio`` rule at >= 0.7x per
+    added process, enforced only on hosts with at least ``procs_max``
+    CPUs (``cpus`` rides the record: on a 1-core container the extra
+    processes time-slice one core and the ratio measures the scheduler,
+    not the architecture). Every reply is asserted oracle-exact.
+    """
+    import os
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+    from sieve.seed import seed_primes
+    from sieve.service import ServiceClient
+
+    n = 1_000_000
+    oracle = seed_primes(n + 1)
+
+    def o_pi(x: int) -> int:
+        return int(np.searchsorted(oracle, x, side="right"))
+
+    xs = [(7919 * (i + 1)) % n for i in range(128)]
+    want = [o_pi(x) for x in xs]
+    reps = 3  # per-thread passes over xs in the timed window
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    qps: dict[int, float] = {}
+    with tempfile.TemporaryDirectory(prefix="sieve_bench_scale") as ck:
+        cfg = SieveConfig(
+            n=n, backend="cpu-numpy", packing="odds", n_segments=8,
+            checkpoint_dir=ck, quiet=True,
+        )
+        run_local(cfg)
+        for procs in (1, 2, 4):
+            cmd = [sys.executable, "-m", "sieve", "serve", "--n", str(n),
+                   "--segments", "8", "--checkpoint-dir", ck,
+                   "--addr", "127.0.0.1:0", "--procs", str(procs),
+                   "--quiet"]
+            proc = subprocess.Popen(cmd, env=env, cwd=repo,
+                                    stdout=subprocess.PIPE, text=True)
+            assert proc.stdout is not None
+            doc = json.loads(proc.stdout.readline())
+            assert doc.get("event") == "serving", doc
+            addr = doc["addr"]
+            try:
+                # warm every process's index/LRU: fresh connections
+                # spread over the fleet until each answered some
+                for _ in range(max(4, 2 * procs)):
+                    with ServiceClient(addr, timeout_s=60) as c:
+                        for x, w in zip(xs[:32], want[:32]):
+                            assert c.pi(x) == w, \
+                                f"warm pi({x}) parity failure"
+
+                errs: list[BaseException] = []
+
+                def pump() -> None:
+                    try:
+                        with ServiceClient(addr, timeout_s=60) as c:
+                            for _ in range(reps):
+                                for x, w in zip(xs, want):
+                                    assert c.pi(x) == w, \
+                                        f"pi({x}) parity failure"
+                    except BaseException as e:  # noqa: BLE001
+                        errs.append(e)
+
+                threads = [threading.Thread(target=pump)
+                           for _ in range(procs)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(300)
+                elapsed = time.perf_counter() - t0
+                assert not errs, f"scaling pump failed: {errs[0]!r}"
+                assert not any(t.is_alive() for t in threads), \
+                    "scaling pump hung"
+                qps[procs] = procs * reps * len(xs) / elapsed
+            finally:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    ratio = qps[4] / (4 * qps[1])
+    print(
+        json.dumps(
+            {
+                "metric": "service_hot_qps_scaling",
+                "value": round(ratio, 3),
+                "unit": "scaling_ratio",
+                "qps_1": round(qps[1], 1),
+                "qps_2": round(qps[2], 1),
+                "qps_4": round(qps[4], 1),
+                "procs_max": 4,
+                "cpus": os.cpu_count(),
+                "queries_per_proc": reps * len(xs),
+            }
+        )
+    )
+
+
 def service_hot_under_flood_metric() -> None:
     """Priority-lane metric (ISSUE 10): hot-query p95 while a 20-thread
     cold flood saturates the backend plane (``cold_delay_s`` simulated).
@@ -1142,6 +1257,7 @@ def main() -> int:
     fused_reduction_metric()
     service_latency_metric()
     service_hot_qps_metric()
+    service_hot_qps_scaling_metric()
     service_hot_under_flood_metric()
     router_query_latency_metric()
     service_trace_overhead_metric()

@@ -82,6 +82,11 @@ CANONICAL_LOCK_ORDER: tuple[str, ...] = (
     # -- index tier
     "SieveIndex._stat_lock",
     "BitsetLRU._lock",
+    # -- tiered segment store (ISSUE 17): entered from index demotion
+    #    callbacks (fired AFTER BitsetLRU._lock is released) and from
+    #    the store's own compactor thread; holds only leaf locks below
+    #    (ChaosSchedule draw, metrics emits happen outside _lock)
+    "TieredSegmentStore._lock",
     # -- client wire-event logger init (ISSUE 16): taken during client
     #    construction (possibly under _Replica.lock) and released
     #    before the metrics leaf locks below are touched
@@ -129,6 +134,16 @@ BLOCKING_PREFIXES = (
     "sieve.checkpoint:",   # ledger I/O (fsync)
     "sieve.service.server:ColdBackend.",   # backend dispatch
     "sieve.service.server:ColdBatcher.submit",  # waits on a flight
+    # tiered segment store (ISSUE 17): appends/loads/compaction do file
+    # I/O under a cross-process flock. Listed per-method on purpose —
+    # stats()/health() are in-memory snapshots the wire loop answers
+    # inline, so the whole module must NOT be blanket-blocking.
+    "sieve.service.store:TieredSegmentStore.put_",
+    "sieve.service.store:TieredSegmentStore.load_",
+    "sieve.service.store:TieredSegmentStore.compact",
+    "sieve.service.store:TieredSegmentStore.maybe_refresh",
+    "sieve.service.store:TieredSegmentStore.import_ledger",
+    "sieve.service.store:TieredSegmentStore.close",
 )
 
 APP_ROLE_CLASSES = frozenset({
@@ -138,6 +153,7 @@ APP_ROLE_CLASSES = frozenset({
     "ClientPool",
     "ReplicaSet",
     "ColdBackend",
+    "TieredSegmentStore",
 })
 
 

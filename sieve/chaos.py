@@ -118,6 +118,18 @@ Wire plane (ISSUE 14; drawn on the request sequence like the other
                               and the throttled client still gets an
                               exact answer, just slowly.
 
+Segment store (ISSUE 17; drawn by the tiered segment store on its own
+*append* counter, like the batcher draws batch dispatches):
+
+* ``store_torn_write:any@sK``  the K-th store append is written torn:
+                              same record length, garbled interior, so
+                              the per-entry CRC fails while the file
+                              framing survives. Readers must skip
+                              exactly that entry with a counted
+                              ``store_torn_entry`` event and
+                              re-materialize the chunk — never a crash
+                              or a wrong answer.
+
 Flight recorder (ISSUE 13):
 
 * ``svc_crash:any@sK``        request K's worker thread raises uncaught
@@ -169,13 +181,15 @@ KINDS = (
     "svc_trace_drop",
     "svc_crash",
     "svc_slow_frame",
+    "store_torn_write",
 )
 # kinds handled by the query service (sieve/service/); the cluster plane
 # ignores these and vice versa. Request-scoped kinds key on the request
 # sequence number; svc_refresh_corrupt keys on the refresh attempt
 # number and is drawn by the LedgerFollower, not the dispatcher;
 # svc_batch_partial keys on the batch-dispatch number and is drawn by
-# the ColdBatcher.
+# the ColdBatcher; store_torn_write keys on the store's append counter
+# and is drawn by the TieredSegmentStore.
 SERVICE_KINDS = (
     "svc_stall",
     "svc_shed",
@@ -188,6 +202,7 @@ SERVICE_KINDS = (
     "svc_trace_drop",
     "svc_crash",
     "svc_slow_frame",
+    "store_torn_write",
 )
 SERVICE_REQUEST_KINDS = (
     "svc_stall",
@@ -230,6 +245,7 @@ DEFAULT_PARAM: dict[str, float | str | None] = {
     "svc_crash": None,
     # param = reply bytes written per event-loop tick on that connection
     "svc_slow_frame": 1.0,
+    "store_torn_write": None,
 }
 
 

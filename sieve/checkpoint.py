@@ -301,6 +301,21 @@ class Ledger:
     def completed(self) -> dict[int, SegmentResult]:
         return {k: SegmentResult.from_dict(v) for k, v in self._entries.items()}
 
+    def store_tier0_entries(self) -> list[tuple[int, int, int]]:
+        """Tier import seam for the segment store (ISSUE 17): every
+        completed segment as sorted ``(lo, hi, count)``. The elected
+        writer seeds the store's tier 0 from these at open — count
+        facts exist for the whole covered range before anything was
+        ever materialized, and the store's export
+        (``TieredSegmentStore.export_counts``) round-trips them."""
+        out: list[tuple[int, int, int]] = []
+        for e in self._entries.values():
+            try:
+                out.append((int(e["lo"]), int(e["hi"]), int(e["count"])))
+            except (KeyError, TypeError, ValueError):
+                continue
+        return sorted(out)
+
     def record(self, res: SegmentResult) -> None:
         """Idempotent: the ledger keys on segment id, so a segment processed
         twice (e.g. after worker-failure reassignment) is counted once."""

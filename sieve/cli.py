@@ -24,6 +24,7 @@ import argparse
 import json
 import sys
 
+from sieve import env
 from sieve.config import BACKENDS, PACKINGS, SieveConfig
 
 
@@ -241,11 +242,29 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-file", default=None, dest="metrics_file")
     p.add_argument("--quiet", action="store_true",
                    help="suppress per-request stderr event lines")
+    p.add_argument("--procs", type=int, default=None,
+                   help="serving processes SO_REUSEPORT-bound to ONE port "
+                        "(escapes the GIL: each runs its own event loop "
+                        "and worker pool over the shared mmap'd segment "
+                        "store; process 0 is the designated writer for "
+                        "persist-cold and store compaction, the rest "
+                        "follow store/ledger generations read-only). "
+                        "Default SIEVE_SVC_PROCS/1")
+    # internal: set by the --procs supervisor when it re-execs itself as
+    # child i; never set by hand
+    p.add_argument("--proc-index", type=int, default=None,
+                   help=argparse.SUPPRESS)
     return p
 
 
 def _serve(argv: list[str]) -> int:
     args = build_serve_parser().parse_args(argv)
+    procs = args.procs if args.procs is not None \
+        else env.env_int("SIEVE_SVC_PROCS", 1)
+    if procs > 1 and args.proc_index is None:
+        # supervisor: spawn N SO_REUSEPORT children on one port and
+        # babysit them; this process never serves traffic itself
+        return _serve_supervisor(argv, args, procs)
     config = SieveConfig(
         n=args.n,
         backend=args.backend,
@@ -284,6 +303,13 @@ def _serve(argv: list[str]) -> int:
         overrides["persist_cold"] = True
     if args.debug_dir is not None:
         overrides["debug_dir"] = args.debug_dir
+    if procs > 1:
+        # child of the --procs supervisor: everyone binds the SAME port
+        # via SO_REUSEPORT; only process 0 writes (persist-cold ledger
+        # appends + store compaction), the rest follow read-only
+        overrides["procs"] = procs
+        overrides["proc_index"] = args.proc_index or 0
+        overrides["reuse_port"] = True
     settings = ServiceSettings.from_env(**overrides)
 
     file_sink = None
@@ -303,6 +329,8 @@ def _serve(argv: list[str]) -> int:
             "covered_hi": service.index.covered_hi,
             "total_primes": service.index.total_primes,
             "segments": len(service.index.segments),
+            "proc": settings.proc_index,
+            "procs": settings.procs,
         }), flush=True)
         import signal
 
@@ -312,11 +340,23 @@ def _serve(argv: list[str]) -> int:
         signal.signal(signal.SIGTERM, lambda *_: service.drain())
         service.drain_event.wait()  # serve until SIGTERM/shutdown
         drained = service.wait_drained(settings.drain_s)
+        # the stats subset carries what per-process observers need when
+        # N procs share one port (per-proc wire stats are unreachable
+        # from outside: the kernel picks which process answers a
+        # connection) — tools/store_smoke.py asserts on these
+        final = service.stats()
         print(json.dumps({
             "event": "drained",
             "clean": drained,
-            "stats": {k: service.stats()[k]
-                      for k in ("requests", "draining_replies")},
+            "proc": settings.proc_index,
+            "stats": {k: final[k]
+                      for k in ("requests", "draining_replies",
+                                "materialized", "cold_computes",
+                                "cold_dispatches", "lru_hits",
+                                "store_hits")},
+            "store": final["store"] and {
+                k: final["store"][k]
+                for k in ("gen", "writer", "hits", "demotions", "torn")},
         }), flush=True)
     except KeyboardInterrupt:
         pass
@@ -329,6 +369,143 @@ def _serve(argv: list[str]) -> int:
             metrics.remove_sink(file_sink)
             file_sink.close()
     return 0
+
+
+def _serve_supervisor(argv: list[str], args, procs: int) -> int:
+    """``serve --procs N``: N serving processes, ONE port.
+
+    Python threads share one GIL, so a single process tops out near one
+    core no matter how many worker threads it runs. The supervisor
+    escapes that by spawning N full server processes that each bind the
+    same TCP port with SO_REUSEPORT — the kernel load-balances incoming
+    connections across them, and the mmap'd segment store keeps their
+    hot tiers shared through the page cache instead of N private copies.
+
+    Mechanics: when --addr asks for port 0 the supervisor reserves a
+    concrete port first (SO_REUSEPORT-bound, never listening, so it
+    receives no connections) and pins every child to it; children are
+    re-execs of this very command line with --proc-index i added.
+    Child serving lines are swallowed into one consolidated supervisor
+    line; everything else (drained lines, metrics) is forwarded verbatim
+    so per-process stats stay observable. SIGTERM/SIGINT fan out as
+    SIGTERM (graceful drain) to every child; the exit code is 0 only if
+    every child drained cleanly.
+    """
+    import signal
+    import socket
+    import subprocess
+    import threading
+
+    from sieve.rpc import parse_addr
+
+    if not hasattr(socket, "SO_REUSEPORT"):
+        print(json.dumps({"event": "error",
+                          "detail": "--procs needs SO_REUSEPORT, which "
+                                    "this platform lacks"}), flush=True)
+        return 2
+    host, port = parse_addr(args.addr)
+    reserve = None
+    if port == 0:
+        # reserve a concrete port for the whole fleet: bound (so the
+        # kernel won't hand it to anyone else) but never listening (so
+        # it steals no connections from the children)
+        reserve = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        reserve.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        reserve.bind((host, 0))
+        port = reserve.getsockname()[1]
+    addr = f"{host}:{port}"
+
+    # child argv = this argv with addr pinned and proc identity added
+    base: list[str] = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a in ("--addr", "--procs", "--proc-index"):
+            skip = True
+            continue
+        if a.startswith(("--addr=", "--procs=", "--proc-index=")):
+            continue
+        base.append(a)
+
+    children: list[subprocess.Popen] = []
+    serving: list[threading.Event] = []
+    first_line: list[dict | None] = [None] * procs
+
+    def _forward(i: int, proc: subprocess.Popen) -> None:
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            line = line.rstrip("\n")
+            if first_line[i] is None:
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    doc = None
+                if doc is not None and doc.get("event") == "serving":
+                    # swallowed: the consolidated supervisor line below
+                    # is THE serving announcement wrappers parse
+                    first_line[i] = doc
+                    serving[i].set()
+                    continue
+            print(line, flush=True)
+
+    try:
+        for i in range(procs):
+            cmd = [sys.executable, "-m", "sieve", "serve", *base,
+                   "--addr", addr, "--procs", str(procs),
+                   "--proc-index", str(i)]
+            p = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+            children.append(p)
+            serving.append(threading.Event())
+            threading.Thread(target=_forward, args=(i, children[i]),
+                             daemon=True, name=f"serve-fwd-{i}").start()
+        for i, ev in enumerate(serving):
+            while not ev.wait(0.2):
+                if children[i].poll() is not None:
+                    raise RuntimeError(f"proc {i} exited "
+                                       f"rc={children[i].returncode} "
+                                       "before serving")
+        if reserve is not None:
+            reserve.close()  # every child holds the port now
+            reserve = None
+        doc0 = first_line[0] or {}
+        print(json.dumps({
+            "event": "serving",
+            "addr": addr,
+            "covered_hi": doc0.get("covered_hi"),
+            "total_primes": doc0.get("total_primes"),
+            "segments": doc0.get("segments"),
+            "procs": procs,
+            "supervisor": True,
+        }), flush=True)
+
+        stop = threading.Event()
+
+        def _fan_out(*_sig) -> None:
+            stop.set()
+            for p in children:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _fan_out)
+        signal.signal(signal.SIGINT, _fan_out)
+        while not stop.is_set():
+            if any(p.poll() is not None for p in children):
+                _fan_out()  # one child died: drain the rest, report
+                break
+            stop.wait(0.2)
+        rcs = [p.wait() for p in children]
+        print(json.dumps({"event": "drained", "supervisor": True,
+                          "clean": all(rc == 0 for rc in rcs),
+                          "rcs": rcs}), flush=True)
+        return 0 if all(rc == 0 for rc in rcs) else 1
+    finally:
+        if reserve is not None:
+            reserve.close()
+        for p in children:
+            if p.poll() is None:
+                p.kill()
 
 
 def build_route_parser() -> argparse.ArgumentParser:

@@ -144,6 +144,16 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     # Emitted once per downgraded connection so a supposedly-binary
     # fleet silently running JSON is visible in the metrics stream.
     "wire_downgrade": {"addr", "negotiated"},
+    # tiered segment store (ISSUE 17): store_demoted marks an LRU/_pv
+    # eviction landing in tier 2 (bytes = wheel-compressed payload);
+    # store_compacted one generation swap by the elected writer
+    # (reclaimed_bytes may be negative if peers appended mid-compaction);
+    # store_torn_entry one checksum-failed record skipped by a reader or
+    # deliberately written torn by the store_torn_write chaos kind —
+    # counted, never fatal, the chunk simply re-materializes.
+    "store_demoted": {"lo", "hi", "bytes", "tier"},
+    "store_compacted": {"gen", "live", "reclaimed_bytes", "downgraded"},
+    "store_torn_entry": {"offset", "gen"},
 }
 
 

@@ -83,6 +83,25 @@ suffix) and ``query_batch``, and :class:`ClientPool` gives the fleet
 tools one reused pipelined connection per endpoint. The
 ``svc_slow_frame`` chaos kind dribbles one connection's replies
 byte-by-byte to prove no cross-connection head-of-line blocking.
+
+Multi-process serving over a tiered segment store (ISSUE 17): Python
+threads share one GIL, so ``python -m sieve serve --procs N`` escapes
+it — N full server processes SO_REUSEPORT-bind ONE port (the kernel
+load-balances connections), each running its own event loop and worker
+pool. What makes that cheap is :class:`TieredSegmentStore`
+(sieve/service/store.py): an mmap'd, append-only, per-record-CRC'd
+store under the checkpoint dir holding three tiers per chunk — counts
+only (0), boundary words (1), and full wheel-210-compressed bitsets
+(2, 48 residues per 210 values ≈ 0.229 bits/value). ``BitsetLRU``
+evictions DEMOTE into tier 2 instead of vanishing, so hot chunks
+survive both eviction and restart, shared across all N processes
+through the page cache instead of N private copies. Process 0 is the
+designated writer (persist-cold ledger appends, background
+compaction + atomic generation swaps); the rest follow generations
+read-only on the ledger-follower cadence. The ``store_torn_write``
+chaos kind garbles a record mid-append: CRC readers skip it, count a
+``store_torn_entry`` event, and re-materialize — never a crash, never
+a wrong answer.
 """
 
 from sieve.service.client import (
@@ -106,6 +125,7 @@ from sieve.service.server import (
     SieveService,
 )
 from sieve.service.shards import Shard, ShardMap
+from sieve.service.store import StoreSettings, TieredSegmentStore
 
 __all__ = [
     "BadRequest",
@@ -129,4 +149,6 @@ __all__ = [
     "SieveIndex",
     "SieveRouter",
     "SieveService",
+    "StoreSettings",
+    "TieredSegmentStore",
 ]

@@ -54,6 +54,7 @@ class QueryCtx:
     # tier provenance for the reply's "source" and the hit counters
     index: bool = False
     lru_hit: bool = False
+    store_hit: bool = False
     materialized: bool = False
     cold: bool = False
     cold_cached: bool = False
@@ -71,17 +72,27 @@ class QueryCtx:
             self.check()
 
     def source(self) -> str:
-        hot = self.index or self.lru_hit or self.materialized or self.cold_cached
+        hot = (self.index or self.lru_hit or self.store_hit
+               or self.materialized or self.cold_cached)
         if self.cold:
             return "mixed" if hot else "cold"
         return "index" if hot else "none"
 
 
 class BitsetLRU:
-    """Bounded cache of materialized flag arrays keyed on (lo, hi)."""
+    """Bounded cache of materialized flag arrays keyed on (lo, hi).
 
-    def __init__(self, capacity: int):
+    ``on_evict(lo, hi, arr)`` fires for every capacity eviction — the
+    tiered segment store's demotion hook (ISSUE 17): work leaves the
+    cache, not the process. It is invoked *outside* the cache lock (so
+    the store's own lock never nests under it) and must not raise (the
+    index wraps it with an error counter)."""
+
+    def __init__(self, capacity: int, on_evict=None):
         self.capacity = capacity
+        self.on_evict = on_evict  # guard: none(reference swap only; the
+        # follower re-points it at each new index's demoter — any
+        # snapshot's demoter writes identical bytes for a given key)
         self._lock = named_lock("BitsetLRU._lock")
         self._cache: "collections.OrderedDict[tuple[int, int], np.ndarray]" = (
             collections.OrderedDict()
@@ -96,11 +107,16 @@ class BitsetLRU:
 
     def put(self, lo: int, hi: int, flags: np.ndarray) -> None:
         flags.setflags(write=False)
+        evicted = []
         with self._lock:
             self._cache[(lo, hi)] = flags
             self._cache.move_to_end((lo, hi))
             while len(self._cache) > self.capacity:
-                self._cache.popitem(last=False)
+                evicted.append(self._cache.popitem(last=False))
+        on_evict = self.on_evict
+        if on_evict is not None:
+            for (elo, ehi), arr in evicted:
+                on_evict(elo, ehi, arr)
 
     def __len__(self) -> int:
         with self._lock:
@@ -117,6 +133,7 @@ class SieveIndex:
         lru_segments: int = 32,
         lru: BitsetLRU | None = None,
         base: int = 2,
+        store=None,
     ):
         self.packing = packing
         self.layout = get_layout(packing)
@@ -161,9 +178,37 @@ class SieveIndex:
         # chunk prime-value arrays for count_upto_batch (ISSUE 16): same
         # (lo, hi) keys as the flags LRU, content equally snapshot-free
         self._pv = BitsetLRU(lru_segments)
+        # tiered segment store (ISSUE 17): consulted on LRU misses
+        # before sieving, fed by LRU evictions. Shared across snapshot
+        # swaps exactly like the LRU (content keys on (packing, lo, hi))
+        self.store = store  # guard: none(reference set at construction;
+        # the follower hands every new index the same store object)
         self._stat_lock = named_lock("SieveIndex._stat_lock")
         self.lru_hits = 0  # guard: _stat_lock
         self.materialized = 0  # guard: _stat_lock
+        self.store_hits = 0  # guard: _stat_lock
+        self.store_errors = 0  # guard: _stat_lock
+        if store is not None:
+            self.lru.on_evict = self._demote_flags
+            self._pv.on_evict = self._demote_values
+
+    # --- store demotion (ISSUE 17) ---------------------------------------
+
+    def _demote_flags(self, lo: int, hi: int, flags: np.ndarray) -> None:
+        """Eviction hook: a flag array leaves the LRU -> tier 2."""
+        try:
+            self.store.put_flags(lo, hi, flags, self.layout)
+        except Exception:
+            with self._stat_lock:
+                self.store_errors += 1
+
+    def _demote_values(self, lo: int, hi: int, values: np.ndarray) -> None:
+        """Eviction hook for the prime-value cache (ISSUE 16's _pv)."""
+        try:
+            self.store.put_values(lo, hi, values, self.layout)
+        except Exception:
+            with self._stat_lock:
+                self.store_errors += 1
 
     # --- flags -----------------------------------------------------------
 
@@ -179,6 +224,14 @@ class SieveIndex:
             with self._stat_lock:
                 self.lru_hits += 1
             return flags
+        if self.store is not None:
+            flags = self.store.load_flags(lo, hi, self.layout)
+            if flags is not None:
+                ctx.store_hit = True
+                with self._stat_lock:
+                    self.store_hits += 1
+                self.lru.put(lo, hi, flags)
+                return flags
         ctx.tick()
         with trace.span("query.materialize", lo=lo, hi=hi):
             seeds = seed_primes(math.isqrt(hi - 1))
@@ -210,16 +263,19 @@ class SieveIndex:
             return flags
         j = bisect.bisect_right(self.bounds, slo) - 1
         seg = self.segments[min(j, len(self.segments) - 1)]
-        whole = self.lru.get(seg.lo, seg.hi)
-        if whole is not None:
-            ctx.lru_hit = True
-            with self._stat_lock:
-                self.lru_hits += 1
-            off = self.layout.nbits(seg.lo, slo)
+        # materialize on the segment-aligned chunk grid count_upto uses:
+        # one LRU/store key per chunk serves pi, count, and primes alike.
+        # A per-query slice key would miss the tiered store (demotions
+        # are chunk-keyed, ISSUE 17) and re-sieve ranges it already holds
+        clo = seg.lo + (slo - seg.lo) // INDEX_CHUNK * INDEX_CHUNK
+        chi = min(clo + INDEX_CHUNK, seg.hi)
+        if shi <= chi:
+            whole = self.get_flags(clo, chi, ctx)
+            off = self.layout.nbits(clo, slo)
             return whole[off : off + self.layout.nbits(slo, shi)]
         if shi - slo > INDEX_CHUNK:
             return None  # oversized ask; let the caller sub-chunk
-        return self.get_flags(slo, shi, ctx)
+        return self.get_flags(slo, shi, ctx)  # chunk-straddling slice
 
     # --- prefix counts ---------------------------------------------------
 
@@ -414,5 +470,7 @@ class SieveIndex:
                 "total_primes": self.total_primes,
                 "lru_hits": self.lru_hits,
                 "materialized": self.materialized,
+                "store_hits": self.store_hits,
+                "store_errors": self.store_errors,
                 "lru_entries": len(self.lru),
             }

@@ -121,6 +121,7 @@ from sieve.checkpoint import (
 )
 from sieve.enumerate import MAX_HI, primes_in_range
 from sieve.metrics import MetricsHistory, MetricsLogger, registry, sample_interval_s
+from sieve.service.store import StoreSettings, TieredSegmentStore
 from sieve.rpc import (
     SUPPORTED_WIRE,
     WIRE_V1,
@@ -309,6 +310,22 @@ class ServiceSettings:
     # — the mixed-fleet simulation knob and the emergency off-switch;
     # clients detect the downgrade and log one ``wire_downgrade`` event.
     wire_v2: bool = True
+    # multi-process serving (ISSUE 17): procs is the fleet size the CLI
+    # supervisor spawns (1 = classic single process; the env spelling is
+    # SIEVE_SVC_PROCS); proc_index is THIS process's slot in that fleet
+    # (set by the supervisor, never from env) — index 0 is the elected
+    # writer owning persist-cold and store compaction, every other index
+    # runs read-only against the shared store/ledger. reuse_port binds
+    # the listener with SO_REUSEPORT so N processes share one port.
+    procs: int = 1
+    proc_index: int = 0
+    reuse_port: bool = False
+    # tiered segment store (ISSUE 17): on by default whenever the config
+    # has a checkpoint_dir; SIEVE_STORE=0 is the off-switch. The store's
+    # own knobs (SIEVE_STORE_FSYNC / _COMPACT_S / _COMPACT_RATIO /
+    # _MIN_COMPACT_BYTES / _T2_BYTES / _REFRESH_S) are read by
+    # sieve.service.store.StoreSettings.from_env.
+    store: bool = True
 
     def validate(self) -> "ServiceSettings":
         """Typed startup validation: every rejection names the setting
@@ -363,6 +380,19 @@ class ServiceSettings:
             raise ValueError(
                 f"service settings: range_lo={self.range_lo!r} must be an "
                 "integer >= 2"
+            )
+        if (not isinstance(self.procs, int) or isinstance(self.procs, bool)
+                or self.procs < 1):
+            raise ValueError(
+                f"service settings: procs={self.procs!r} must be a "
+                "positive integer"
+            )
+        if (not isinstance(self.proc_index, int)
+                or isinstance(self.proc_index, bool)
+                or not 0 <= self.proc_index < max(self.procs, 1)):
+            raise ValueError(
+                f"service settings: proc_index={self.proc_index!r} must "
+                f"be in [0, procs={self.procs})"
             )
         if (not isinstance(self.slo_window, int)
                 or isinstance(self.slo_window, bool) or self.slo_window <= 0):
@@ -458,6 +488,9 @@ class ServiceSettings:
             write_queue_bytes=_env_int(
                 "SIEVE_SVC_WRITE_QUEUE", cls.write_queue_bytes
             ),
+            procs=_env_int("SIEVE_SVC_PROCS", cls.procs),
+            reuse_port=_env_bool("SIEVE_SVC_REUSE_PORT", "0"),
+            store=_env_bool("SIEVE_STORE", "1"),
         )
         return dataclasses.replace(s, **overrides)
 
@@ -807,6 +840,15 @@ class LedgerFollower:
 
     def _poll_locked(self) -> str:
         svc = self.service
+        # Non-writer processes learn about new store generations (post-
+        # compaction pointer swaps) and freshly appended peer demotions
+        # here, on the same cadence as ledger follows.  Independent of
+        # the ledger fingerprint: peer appends don't touch the ledger.
+        if svc.store is not None:
+            try:
+                svc.store.maybe_refresh()
+            except Exception:  # noqa: BLE001 — the follower never dies
+                pass
         fp = ledger_fingerprint(self._path)
         if fp == self._last_fp:
             return "unchanged"
@@ -827,6 +869,7 @@ class LedgerFollower:
         new = SieveIndex(
             svc.config.packing, led.completed(),
             svc.settings.lru_segments, lru=old.lru, base=old.base,
+            store=old.store,
         )
         if new.covered_hi < old.covered_hi:
             self._failed(
@@ -982,6 +1025,24 @@ class SieveService:
         if config.checkpoint_dir:
             self.ledger = self._open_snapshot()
             entries = self.ledger.completed()
+        self.chaos = ChaosSchedule(config.chaos_directives())
+        # tiered segment store (ISSUE 17): mmap'd tiers under the
+        # checkpoint dir, shared by every --procs sibling through the
+        # page cache. proc 0 is the elected writer (tier-0 ledger
+        # import + background compaction); every process appends
+        # demotions and follows generations. SIEVE_STORE=0 disables.
+        self.store: TieredSegmentStore | None = None  # guard: none(set
+        # once at construction; readers null-check)
+        if config.checkpoint_dir and self.settings.store:
+            self.store = TieredSegmentStore(
+                os.path.join(config.checkpoint_dir, "store"),
+                writer=(self.settings.proc_index == 0),
+                settings=StoreSettings.from_env(),
+                chaos=self.chaos,
+                events=self.metrics.event,
+            )
+            if self.store.writer and self.ledger is not None:
+                self.store.import_ledger(self.ledger.store_tier0_entries())
         # range sharding (ISSUE 11): the index anchors its contiguous
         # prefix at range_lo, so this server natively speaks shard-local
         # semantics (counts from range_lo, nth >= range_lo)
@@ -989,7 +1050,7 @@ class SieveService:
         self.index = SieveIndex(  # guard: none(follower reference
             # swap; readers take one snapshot per message)
             config.packing, entries, self.settings.lru_segments,
-            base=self.base,
+            base=self.base, store=self.store,
         )
         registry().gauge("cluster.covered_hi").set(
             float(self.index.covered_hi)
@@ -1002,7 +1063,6 @@ class SieveService:
         self.follower: LedgerFollower | None = None  # guard: none(set
         # once in start(); readers null-check)
         self.cold = ColdBackend(config, self.settings, self._on_degraded)
-        self.chaos = ChaosSchedule(config.chaos_directives())
         self._cold_lock = named_lock("SieveService._cold_lock")
         # LRU of chunk results, most-recent at the end: O(1) hit
         # (move_to_end) and O(1) eviction (popitem(last=False)) — the
@@ -1014,7 +1074,11 @@ class SieveService:
         # --persist-cold: this server owns the checkpoint dir's ledger
         # as a writer; only the batcher thread ever records into it
         self._writer: Ledger | None = None
-        if self.settings.persist_cold and config.checkpoint_dir:
+        # writer election (ISSUE 17): in a --procs fleet only proc 0
+        # may own the ledger as a writer — readers keep persist_cold
+        # semantics through the shared store + ledger follow instead
+        if self.settings.persist_cold and config.checkpoint_dir \
+                and self.settings.proc_index == 0:
             self._writer = Ledger.open(config)
         # priority lanes (ISSUE 10): two bounded deques under one
         # condition. Dedicated hot workers only ever pull "hot"; shared
@@ -1124,7 +1188,10 @@ class SieveService:
 
     def start(self) -> "SieveService":
         host, port = parse_addr(self._addr_req)
-        self._listener = socket.create_server((host, port))
+        # SO_REUSEPORT (ISSUE 17): N sibling processes bind the same
+        # port and the kernel load-balances connections across them
+        self._listener = socket.create_server(
+            (host, port), reuse_port=self.settings.reuse_port)
         self._listener.listen(64)
         bhost, bport = self._listener.getsockname()[:2]
         self._bound_addr = f"{bhost}:{bport}"
@@ -1144,6 +1211,8 @@ class SieveService:
             w.start()
             self._threads.append(w)
         self.batcher.start()
+        if self.store is not None:
+            self.store.start()  # writer: background compactor
         if self.config.checkpoint_dir and self.settings.refresh_s > 0:
             self.follower = LedgerFollower(
                 self, self.settings.refresh_s
@@ -1229,6 +1298,8 @@ class SieveService:
                 pass
         self.batcher.stop()
         self.cold.close()
+        if self.store is not None:
+            self.store.close()
         if self.recorder is not None:
             self.recorder.uninstall()
             self.history.stop()
@@ -1419,6 +1490,11 @@ class SieveService:
         out["draining"] = self._draining
         out["persist_cold"] = self._writer is not None
         out["range_lo"] = self.base
+        out["procs"] = self.settings.procs
+        out["proc_index"] = self.settings.proc_index
+        # store.stats() is in-memory only (no I/O, no flock) so it is
+        # safe from the inline stats op on the wire loop
+        out["store"] = self.store.stats() if self.store is not None else None
         out["slo"] = self.slo_snapshot()
         return out
 
@@ -1756,6 +1832,11 @@ class SieveService:
                 "refreshes": self._refreshes,
                 "draining": self._draining,
                 "range_lo": self.base,
+                "proc": self.settings.proc_index,
+                # health() is the store's cheap in-memory subset — safe
+                # inline on the wire loop, unlike the blocking store ops
+                "store": (self.store.health()
+                          if self.store is not None else None),
             }, front=True)
             return None
         if mtype == "stats":

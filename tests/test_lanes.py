@@ -155,9 +155,10 @@ def test_health_and_stats_key_schema_snapshot(service):
     svc, cli = service
     assert cli.pi(30_000) == o_pi(30_000)
     assert sorted(cli.health()) == [
-        "brownout", "covered_hi", "draining", "id", "ok", "queue_depth",
-        "queue_depth_cold", "queue_depth_hot", "range_lo", "refreshes",
-        "snapshot_age_s", "status", "total_primes", "type",
+        "brownout", "covered_hi", "draining", "id", "ok", "proc",
+        "queue_depth", "queue_depth_cold", "queue_depth_hot", "range_lo",
+        "refreshes", "snapshot_age_s", "status", "store", "total_primes",
+        "type",
     ]
     assert sorted(cli.stats()) == [
         "bad_requests", "batch_members", "batch_requests", "brownout",
@@ -169,10 +170,11 @@ def test_health_and_stats_key_schema_snapshot(service):
         "hot_admitted", "hot_workers_dedicated", "index_hits",
         "internal_errors", "lane_shed_cold", "lane_shed_hot",
         "lru_entries", "lru_hits", "materialized", "persist_cold",
-        "queue_depth", "queue_depth_cold", "queue_depth_hot", "range_lo",
-        "refresh_attempts", "refresh_failed", "refreshes", "requests",
-        "segments", "shed", "slo", "slow_consumer_closed",
-        "snapshot_age_s", "telemetry_replies",
+        "proc_index", "procs", "queue_depth", "queue_depth_cold",
+        "queue_depth_hot", "range_lo", "refresh_attempts",
+        "refresh_failed", "refreshes", "requests", "segments", "shed",
+        "slo", "slow_consumer_closed", "snapshot_age_s", "store",
+        "store_errors", "store_hits", "telemetry_replies",
         "total_primes", "trace_drops", "wire_v2_conns",
     ]
 

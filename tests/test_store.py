@@ -1,0 +1,294 @@
+"""The tiered segment store (ISSUE 17 tentpole).
+
+Covers: the wheel-210 at-rest codec against the seed-prime oracle
+(including the 2/3/5/7 side mask and unaligned ranges); tier-0/tier-2
+puts, reads, and restart persistence; the ``store_torn_write`` chaos
+kind (CRC readers skip, count ``store_torn_entry``, re-materialize —
+never a crash, never a wrong answer); cross-handle follow of appends
+and compaction generation swaps; the BitsetLRU demotion hook through
+SieveIndex (evicted chunks come back as store hits, zero
+re-materializations); EVENT_SCHEMA validation of the three new store
+events; the bench_compare ``scaling_ratio`` floor (cpus-gated); and
+tools/store_smoke.py as a tier-1 subprocess gate (multi-process
+SO_REUSEPORT serving, byte-identical replies, warm restart).
+"""
+
+import math
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sieve.backends.cpu_numpy import sieve_segment_flags
+from sieve.bitset import get_layout, pack_wheel210, unpack_wheel210
+from sieve.chaos import ChaosSchedule, parse_chaos
+from sieve.checkpoint import Ledger
+from sieve.config import SieveConfig
+from sieve.coordinator import run_local
+from sieve.metrics import validate_record
+from sieve.seed import seed_primes
+from sieve.service import QueryCtx, SieveIndex, StoreSettings, TieredSegmentStore
+
+REPO = Path(__file__).parent.parent
+ORACLE_HI = 100_000
+P = seed_primes(ORACLE_HI)
+
+
+def o_primes(lo, hi):
+    return P[(P >= lo) & (P < hi)].astype(np.int64)
+
+
+def _flags(packing, lo, hi):
+    """Real post-sieve flags for [lo, hi) — exactly what the LRU holds."""
+    return sieve_segment_flags(packing, lo, hi,
+                               seed_primes(math.isqrt(hi - 1)))
+
+
+# --- wheel-210 codec ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("lo,hi", [
+    (0, 500),          # includes all four wheel primes via small_mask
+    (2, 211),          # lo below 10, one block boundary crossed
+    (1000, 1999),      # unaligned on both ends
+    (209, 421),        # straddles block edges by one value
+    (4200, 4200),      # empty range
+])
+def test_wheel210_roundtrip_oracle(lo, hi):
+    vals = o_primes(lo, hi)
+    payload, small_mask = pack_wheel210(lo, hi, vals)
+    back = unpack_wheel210(lo, hi, payload, small_mask)
+    assert np.array_equal(back, vals)
+    # 6 bytes per touched 210-block, never more
+    if hi > lo:
+        blocks = (hi - 1) // 210 - lo // 210 + 1
+        assert len(payload) == 6 * blocks
+
+
+def test_wheel210_rejects_noncoprime():
+    # 25 shares a factor with 210: a composite "survivor" must raise,
+    # not vanish silently from the at-rest encoding
+    with pytest.raises(ValueError, match="wheel"):
+        pack_wheel210(0, 100, np.array([2, 3, 25], dtype=np.int64))
+
+
+# --- store: tiers, persistence, torn writes ----------------------------------
+
+
+def _store(root, **kw):
+    kw.setdefault("settings", StoreSettings(compact_s=0.0))
+    return TieredSegmentStore(root, **kw)
+
+
+def test_store_tiers_and_restart_persistence(tmp_path):
+    layout = get_layout("odds")
+    lo, hi = 1050, 2940
+    flags = _flags("odds", lo, hi)
+    with _store(tmp_path, writer=True) as st:
+        st.put_count(5000, 6000, int(o_primes(5000, 6000).size))
+        assert st.put_flags(lo, hi, flags, layout)
+        assert not st.put_flags(lo, hi, flags, layout)  # duplicate: no churn
+        assert st.get_entry(5000, 6000)[0] == 0
+        assert st.get_entry(lo, hi)[0] == 2
+        assert np.array_equal(st.load_values(lo, hi), o_primes(lo, hi))
+        s = st.stats()
+        assert s["entries"] == {0: 1, 1: 0, 2: 1}
+        assert s["demotions"] == 1 and s["writer"]
+    # a fresh handle (restart) sees everything without any recompute
+    with _store(tmp_path, writer=True) as st2:
+        assert st2.stats()["entries"] == {0: 1, 1: 0, 2: 1}
+        got = st2.load_flags(lo, hi, layout)
+        assert np.array_equal(got, flags)
+        assert st2.stats()["hits"] == 1
+
+
+def test_store_low_range_small_mask_roundtrip(tmp_path):
+    # lo=2 exercises the 2/3/5/7 side mask end to end through the store
+    layout = get_layout("odds")
+    flags = _flags("odds", 2, 5000)
+    with _store(tmp_path, writer=True) as st:
+        assert st.put_flags(2, 5000, flags, layout)
+        assert np.array_equal(st.load_flags(2, 5000, layout), flags)
+
+
+def test_store_import_ledger_idempotent(tmp_path):
+    entries = [(2, 1000, 168), (1000, 2000, 135)]
+    with _store(tmp_path, writer=True) as st:
+        assert st.import_ledger(entries) == 2
+        assert st.import_ledger(entries) == 0
+        assert st.get_entry(2, 1000) == (0, 168, 0, 0)
+
+
+def test_store_torn_write_skipped_counted_retried(tmp_path):
+    layout = get_layout("odds")
+    events = []
+    chaos = ChaosSchedule(parse_chaos("store_torn_write:any@s2"))
+    flags = _flags("odds", 1050, 2940)
+    with _store(tmp_path, writer=True, chaos=chaos,
+                events=lambda kind, quietable=False, **f:
+                events.append({"event": kind, "ts": 0.0, **f})) as st:
+        st.put_count(5000, 6000, 101)           # append 1: clean
+        assert not st.put_flags(1050, 2940, flags, layout)  # append 2: torn
+        assert st.get_entry(1050, 2940) is None
+        assert st.load_values(1050, 2940) is None
+        s = st.stats()
+        assert s["torn_writes"] == 1 and s["torn"] == 1
+        assert s["demotions"] == 0              # a torn demotion never counts
+        # chaos draw consumed: the re-materialized demotion lands clean
+        assert st.put_flags(1050, 2940, flags, layout)
+        assert np.array_equal(st.load_values(1050, 2940),
+                              o_primes(1050, 2940))
+    torn = [e for e in events if e["event"] == "store_torn_entry"]
+    assert len(torn) == 1
+    for e in events:
+        validate_record(e)
+    # a restarted reader skips the interior torn record the same way
+    with _store(tmp_path, writer=False) as rd:
+        assert rd.stats()["torn"] == 1
+        assert np.array_equal(rd.load_values(1050, 2940),
+                              o_primes(1050, 2940))
+
+
+def test_store_cross_handle_append_follow(tmp_path):
+    layout = get_layout("odds")
+    flags = _flags("odds", 1050, 2940)
+    with _store(tmp_path, writer=True) as wr, \
+            _store(tmp_path, writer=False) as rd:
+        assert wr.put_flags(1050, 2940, flags, layout)
+        rd.maybe_refresh(force=True)
+        assert rd.get_entry(1050, 2940)[0] == 2
+        assert np.array_equal(rd.load_values(1050, 2940),
+                              o_primes(1050, 2940))
+        assert not rd.writer
+
+
+def test_store_compaction_reclaims_and_peers_follow(tmp_path):
+    layout = get_layout("odds")
+    flags = _flags("odds", 1050, 2940)
+    events = []
+    with _store(tmp_path, writer=True,
+                events=lambda kind, quietable=False, **f:
+                events.append({"event": kind, "ts": 0.0, **f})) as wr, \
+            _store(tmp_path, writer=False) as rd:
+        wr.put_count(1050, 2940, int(o_primes(1050, 2940).size))
+        assert wr.put_flags(1050, 2940, flags, layout)  # supersedes tier 0
+        assert wr.stats()["dead_bytes"] > 0
+        g0 = wr.stats()["gen"]
+        assert wr.compact_once(force=True)
+        s = wr.stats()
+        assert s["gen"] == g0 + 1 and s["compactions"] == 1
+        assert s["dead_bytes"] == 0 and s["entries"] == {0: 0, 1: 0, 2: 1}
+        # the pre-compaction handle follows the pointer swap
+        rd.maybe_refresh(force=True)
+        assert rd.stats()["gen"] == g0 + 1
+        assert np.array_equal(rd.load_values(1050, 2940),
+                              o_primes(1050, 2940))
+        # readers never compact
+        assert not rd.compact_once(force=True)
+    comp = [e for e in events if e["event"] == "store_compacted"]
+    assert len(comp) == 1 and comp[0]["live"] == 1
+    validate_record(comp[0])
+
+
+def test_store_t2_cap_downgrades_oldest(tmp_path):
+    layout = get_layout("odds")
+    with _store(tmp_path, writer=True,
+                settings=StoreSettings(compact_s=0.0, t2_bytes=1)) as st:
+        for lo in (1050, 3150):
+            assert st.put_flags(lo, lo + 1890, _flags("odds", lo, lo + 1890),
+                                layout)
+        assert st.compact_once(force=True)
+        s = st.stats()
+        assert s["downgraded"] >= 1
+        assert s["entries"][1] >= 1
+        # a downgraded entry still answers counts (tier 1), not values
+        tier, count, _, _ = st.get_entry(1050, 2940)
+        assert tier == 1 and count == int(o_primes(1050, 2940).size)
+        assert st.load_values(1050, 2940) is None
+
+
+# --- SieveIndex demotion/readback --------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def sieved_dir(tmp_path_factory):
+    path = tmp_path_factory.mktemp("store_ledger")
+    run_local(SieveConfig(n=50_000, backend="cpu-numpy", packing="odds",
+                          n_segments=4, quiet=True,
+                          checkpoint_dir=str(path)))
+    return path
+
+
+def test_index_evictions_demote_and_hit_store(tmp_path, sieved_dir):
+    cfg = SieveConfig(n=50_000, backend="cpu-numpy", packing="odds",
+                      n_segments=4, quiet=True,
+                      checkpoint_dir=str(sieved_dir))
+    ledger = Ledger.open_readonly(cfg)
+    segs = sorted(ledger.completed().values(), key=lambda r: r.lo)
+    with _store(tmp_path, writer=True) as st:
+        idx = SieveIndex("odds", ledger.completed(), lru_segments=1, store=st)
+        c1 = QueryCtx()
+        f1 = idx.get_flags(segs[0].lo, segs[0].hi, c1)
+        assert c1.materialized
+        c2 = QueryCtx()
+        idx.get_flags(segs[1].lo, segs[1].hi, c2)   # evicts seg 0 -> demote
+        assert st.stats()["demotions"] >= 1
+        c3 = QueryCtx()
+        f3 = idx.get_flags(segs[0].lo, segs[0].hi, c3)
+        assert c3.store_hit and not c3.materialized
+        assert c3.source() == "index"   # store hits stay in the hot tier
+        assert np.array_equal(f1, f3)
+        assert idx.store_hits == 1 and idx.materialized == 2
+
+
+# --- bench_compare scaling floor (satellite 3) --------------------------------
+
+
+def _scaling_rec(value, cpus, procs_max=4):
+    return {"m": {"metric": "m", "value": value, "unit": "scaling_ratio",
+                  "cpus": cpus, "procs_max": procs_max}}
+
+
+def test_bench_compare_scaling_floor_gated_by_cpus():
+    from tools.bench_compare import compare
+    # enough cores and below the floor: gate fires
+    _, reg = compare({}, _scaling_rec(0.4, cpus=8), 0.10)
+    assert reg and "scaling floor" in reg[0]
+    # enough cores, healthy ratio: no regression
+    _, reg = compare({}, _scaling_rec(0.85, cpus=8), 0.10)
+    assert not reg
+    # 1-core container: the ratio measures the scheduler — report only
+    lines, reg = compare({}, _scaling_rec(0.2, cpus=1), 0.10)
+    assert not reg
+    assert any("ungated" in ln for ln in lines)
+
+
+# --- store events in the schema ----------------------------------------------
+
+
+def test_store_event_schema_entries():
+    validate_record({"event": "store_demoted", "ts": 0.0,
+                     "lo": 2, "hi": 100, "bytes": 6, "tier": 2})
+    validate_record({"event": "store_compacted", "ts": 0.0, "gen": 1,
+                     "live": 3, "reclaimed_bytes": 64, "downgraded": 0})
+    validate_record({"event": "store_torn_entry", "ts": 0.0,
+                     "offset": 48, "gen": 0})
+    with pytest.raises(ValueError, match="missing keys"):
+        validate_record({"event": "store_demoted", "ts": 0.0, "lo": 2})
+
+
+# --- the multi-process smoke gate --------------------------------------------
+
+
+def test_store_smoke_tool(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "store_smoke.py"),
+         "--keep", str(tmp_path / "work")],
+        env=env, cwd=str(REPO), capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "STORE_SMOKE_OK" in proc.stdout

@@ -17,8 +17,12 @@ threshold (lower is better — the service p95 gate, ISSUE 9), when any
 first round, since the ceiling needs no baseline), when any
 ``unit == "bytes_per_member"`` metric exceeds its absolute wire-cost
 ceiling or grows past the threshold round-over-round (the binary frame
-budget, ISSUE 16), or when the newest round itself failed
-(``rc != 0`` / ``ok == false``).
+budget, ISSUE 16), when any ``unit == "scaling_ratio"`` metric falls
+below the ABSOLUTE 0.7 floor (the multi-process qps-per-process gate,
+ISSUE 17 — but only when the record's ``cpus`` covers its
+``procs_max``: on a 1-core container extra processes time-slice one
+core and the ratio measures the scheduler, not the architecture), or
+when the newest round itself failed (``rc != 0`` / ``ok == false``).
 
 Round order comes from the ``_r<NN>`` filename suffix, NOT mtime — a
 re-checkout or ``touch`` must not reorder history.
@@ -51,6 +55,13 @@ _OVERHEAD_CEILINGS = {
 # JSON, so 48 flags any drift back toward text-sized frames.
 _DEFAULT_BYTES_CEILING = 48.0
 _BYTES_CEILINGS: dict[str, float] = {}
+# absolute scaling floor (ISSUE 17): a ``scaling_ratio`` metric (e.g.
+# q4 / (4 * q1) for 4-process serving) must keep >= 0.7x of the
+# single-process qps per added process — enforced only when the record
+# says the host has at least ``procs_max`` CPUs; with fewer cores the
+# processes time-slice and the ratio is reported but not gated.
+_DEFAULT_SCALING_FLOOR = 0.7
+_SCALING_FLOORS: dict[str, float] = {}
 
 
 def find_rounds(bench_dir: str, prefix: str) -> list[tuple[int, str]]:
@@ -123,6 +134,32 @@ def compare(
                 f"REGRESSION (> {bceiling} absolute ceiling)"
             )
             continue
+        if n is not None and n.get("unit") == "scaling_ratio":
+            # absolute per-process scaling floor (ISSUE 17) — only
+            # meaningful when the host actually has a core per process;
+            # otherwise the extra processes time-slice one core and the
+            # ratio measures the scheduler, so report without gating
+            floor = _SCALING_FLOORS.get(name, _DEFAULT_SCALING_FLOOR)
+            cpus = int(n.get("cpus") or 0)
+            procs_max = int(n.get("procs_max") or 0)
+            gated = procs_max > 0 and cpus >= procs_max
+            if gated and float(n["value"]) < floor:
+                regressions.append(
+                    f"{name}: {float(n['value']):.4g} below the absolute "
+                    f"{floor} per-process scaling floor "
+                    f"(cpus={cpus} >= procs_max={procs_max})"
+                )
+                lines.append(
+                    f"  {name}: {float(n['value']):.4g} scaling_ratio  "
+                    f"REGRESSION (< {floor} absolute floor)"
+                )
+                continue
+            if not gated:
+                lines.append(
+                    f"  {name}: {float(n['value']):.4g} scaling_ratio  "
+                    f"(ungated: cpus={cpus} < procs_max={procs_max})"
+                )
+                continue
         if o is None:
             # a metric present only in the newest round is reported
             # explicitly (it becomes next round's baseline), never

@@ -5,7 +5,9 @@ Each poll asks the router for health (which names every shard replica
 address), stats, and the new ``metrics`` wire op, then asks each replica
 for the same three. The rendered table shows, per replica: lane queue
 depths, shed/demotion rates, LRU and cold-cache hit rates, cold dispatch
-rate, covered_hi, and the worst per-op SLO burn — plus a router header
+rate, the segment-store column (hit ratio / demotions, plus a ``T<n>``
+torn-entry marker — ISSUE 17), covered_hi, and the worst per-op SLO
+burn — plus a router header
 with request rate, totals-cache hit rate, telemetry merge/gap counters,
 and fabric coverage contiguity. Rates are deltas between consecutive
 polls; the first frame shows totals only.
@@ -147,6 +149,22 @@ def _worst_burn(stats: dict | None) -> str:
     return f"{worst:.2f}x" + ("!" if worst > 1.0 else "")
 
 
+def _store_cell(stats: dict | None) -> str:
+    """``hit%/demotions`` from the nested segment-store stats block
+    (ISSUE 17), or ``-`` when the replica runs without a store."""
+    if not stats:
+        return "-"
+    st = stats.get("store")
+    if not st:
+        return "-"
+    hits = st.get("hits") or 0
+    misses = st.get("misses") or 0
+    hit = _ratio(hits, hits + misses)
+    cell = f"{hit}/{st.get('demotions', 0)}"
+    torn = st.get("torn") or 0
+    return cell + (f" T{torn}" if torn else "")
+
+
 def _prev_stats(prev: dict | None, shard: int | None,
                 addr: str) -> dict | None:
     if prev is None:
@@ -193,7 +211,7 @@ def render(snap: dict, prev: dict | None = None) -> str:
     lines.append(
         f"  {'replica':<22} {'st':<4} {'hot':>4} {'cold':>4} "
         f"{'shed':>8} {'demote':>8} {'lru':>5} {'ccache':>6} "
-        f"{'colddisp':>9} {'covered_hi':>11} {'slo burn':>9}"
+        f"{'colddisp':>9} {'store':>12} {'covered_hi':>11} {'slo burn':>9}"
     )
     for sh in snap["shards"]:
         for rep in sh["replicas"]:
@@ -224,6 +242,7 @@ def render(snap: dict, prev: dict | None = None) -> str:
                 f"{shed_r:>8} {_rate(st, ps, 'demoted', dt):>8} "
                 f"{lru:>5} {ccache:>6} "
                 f"{_rate(st, ps, 'cold_dispatches', dt):>9} "
+                f"{_store_cell(st):>12} "
                 f"{h.get('covered_hi', 0):>11} {_worst_burn(st):>9}"
             )
     return "\n".join(lines)
