@@ -56,6 +56,15 @@ Prints EIGHT JSON lines {"metric", "value", "unit", "vs_baseline"}:
    of p95) fails regardless of the previous round. vs_baseline =
    1.05 / ratio, so >= 1 is within budget. Host-only: emitted anywhere.
 
+9. Mesh cold-drain throughput (ISSUE 18): values/s through one drain
+   slice of equal-span cold chunks on the mesh backend — ONE
+   shard_map/jit SPMD launch spanning every device — via
+   tools/mesh_cold_smoke.py in a subprocess (8-way virtual CPU mesh).
+   Unit ``cold_throughput`` (drop-gated by tools/bench_compare.py);
+   vs_baseline = speedup over the loop backend's K sequential
+   markings, so >= 1 means the one-launch drain wins. Host-only:
+   emitted anywhere.
+
 Exact parity is asserted before any number is printed — the depth line
 against a cpu-numpy run of the same segment: a fast wrong sieve scores
 zero. The service line asserts every reply exact against the index
@@ -1250,6 +1259,43 @@ def service_lock_debug_overhead_metric() -> None:
     )
 
 
+def service_cold_drain_throughput_metric() -> None:
+    """Mesh cold-plane drain throughput (ISSUE 18): values/s through one
+    drain slice of equal-span cold chunks on the mesh backend (ONE
+    shard_map SPMD launch spanning every device) vs the loop backend (K
+    sequential jax markings — what ``--cold-backend loop`` runs per
+    drain). Runs tools/mesh_cold_smoke.py in a subprocess so the 8-way
+    virtual CPU mesh (``XLA_FLAGS``) is forced before jax initializes —
+    this process may already hold a single-device jax. The smoke
+    parity-asserts mesh vs cpu-numpy vs a direct oracle before any
+    number is printed, and fails unless the drain cost exactly one SPMD
+    launch. Unit ``cold_throughput`` — gated against drops by
+    tools/bench_compare.py; vs_baseline = mesh/loop speedup, so >= 1
+    means one drain beats K markings. Host-only: emitted anywhere."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # let the smoke force its 8-device mesh
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "mesh_cold_smoke.py")],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    if proc.returncode != 0 or "MESH_COLD_SMOKE_OK" not in proc.stdout:
+        print(
+            f"cold drain metric skipped: mesh smoke failed "
+            f"(rc={proc.returncode})\n{proc.stdout[-2000:]}"
+            f"{proc.stderr[-2000:]}",
+            file=sys.stderr,
+        )
+        return
+    for line in proc.stdout.splitlines():
+        if line.startswith("{") and "service_cold_drain_throughput" in line:
+            print(line)
+            return
+
+
 def main() -> int:
     shallow_metric()
     depth_metric()
@@ -1263,6 +1309,7 @@ def main() -> int:
     service_trace_overhead_metric()
     service_recorder_overhead_metric()
     service_lock_debug_overhead_metric()
+    service_cold_drain_throughput_metric()
     return 0
 
 

@@ -132,7 +132,14 @@ BLOCKING_PREFIXES = (
     "sieve.rpc:recv_msg",
     "sieve.rpc:_recv_exact",
     "sieve.checkpoint:",   # ledger I/O (fsync)
-    "sieve.service.server:ColdBackend.",   # backend dispatch
+    # cold backend dispatch (ISSUE 18): listed per-method — describe()
+    # and the _state_lock health probes are in-memory snapshots the wire
+    # loop answers inline, so the class must NOT be blanket-blocking
+    "sieve.service.server:ColdBackend.count_range",
+    "sieve.service.server:ColdBackend.count_ranges",
+    "sieve.service.server:ColdBackend._mesh_locked",  # device probe
+    "sieve.service.server:ColdBackend._mesh_dispatch",  # SPMD launch
+    "sieve.service.server:ColdBackend.close",
     "sieve.service.server:ColdBatcher.submit",  # waits on a flight
     # tiered segment store (ISSUE 17): appends/loads/compaction do file
     # I/O under a cross-process flock. Listed per-method on purpose —

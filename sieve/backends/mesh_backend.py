@@ -1,0 +1,171 @@
+"""Mesh-backed cold compute plane (ISSUE 18): one drain, every chip.
+
+``MeshWorker`` implements the ``SieveWorker.process_segments`` seam by
+padding a drained chunk list onto the device mesh and issuing ONE
+``shard_map``/``jit`` SPMD launch over the word kernel per drain slice —
+a cold burst over K chunks costs one multi-device round instead of K
+sequential markings. It reuses JaxWorker's host prepare (TieredChain)
+and shape-bucketed grouping verbatim, so results are bit-exact against
+the loop path by construction; the only new moving part is the batch
+padding onto the mesh:
+
+- the batch's leading dim is padded to ``ndev * next_pow2(ceil(B/ndev))``
+  so every device holds the same number of rows AND the per-device row
+  count buckets to a power of two (jit-cache economy across drains);
+- pad rows duplicate the group's first member — they compute a real
+  (discarded) result, so padding cannot perturb the live rows.
+
+Construction raises when the mesh cannot be built (fewer devices than
+requested); the service's ColdBackend catches that and falls back to the
+loop worker — typed-degraded, never a wrong answer (sieve/service).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from sieve import env, trace
+from sieve.backends.jax_backend import (
+    MIN_DEVICE_BITS,
+    JaxWorker,
+    _pad_to,
+    pair_kind,
+)
+from sieve.bitset import get_layout
+from sieve.kernels.jax_mark import next_pow2
+from sieve.worker import SegmentResult
+
+
+def mesh_device_count() -> int:
+    """Devices the cold mesh should span: ``SIEVE_MESH_COLD_DEVICES``
+    override, else every device on the pinned platform."""
+    want = env.env_int("SIEVE_MESH_COLD_DEVICES", 0)
+    if want > 0:
+        return want
+    import jax
+
+    platform = env.env_str("SIEVE_JAX_PLATFORM")
+    try:
+        return max(1, len(jax.devices(platform) if platform else jax.devices()))
+    except RuntimeError:
+        return 1
+
+
+class MeshWorker(JaxWorker):
+    """SPMD cold-plane worker: ``process_segments`` shards the drained
+    chunk batch over the device mesh (one launch per shape group)."""
+
+    name = "mesh"
+
+    def __init__(self, config, n_devices: int | None = None):
+        super().__init__(config)
+        from sieve.parallel.mesh import _register_mesh, build_mesh
+
+        ndev = int(n_devices) if n_devices else mesh_device_count()
+        self.mesh = build_mesh(ndev)  # raises when the host is too small
+        self._mesh_key = _register_mesh(self.mesh)
+        self.devices = ndev
+        # capacity class for the coordinator hello handshake: a mesh host
+        # marks ndev chunks per round, so it can drain ndev-sized batches
+        self.capacity = ndev
+        self.launches = 0  # guard: caller (ColdBackend._lock / 1 test thread)
+
+    def process_segments(
+        self,
+        segments: list[tuple[int, int]],
+        seed_primes: np.ndarray,
+        seg_ids: list[int] | None = None,
+    ) -> list[SegmentResult]:
+        """One SPMD launch per shape group: same host prepare + grouping
+        as JaxWorker.process_segments, but each group's batch is padded
+        onto the mesh and dispatched through the sharded cold step.
+        Equal-span chunks — the cold plane's fixed grid — land in one
+        group, so a drain slice costs a single multi-device round."""
+        from sieve.parallel.mesh import _make_cold_step
+
+        if seg_ids is None:
+            seg_ids = list(range(len(segments)))
+        if len(seg_ids) != len(segments):
+            raise ValueError(
+                f"process_segments: {len(segments)} segments but "
+                f"{len(seg_ids)} seg_ids"
+            )
+        packing = self.config.packing
+        layout = get_layout(packing)
+        out: list[SegmentResult | None] = [None] * len(segments)
+        # (Wpad, periods, S2, C_padded) -> [(pos, ts, t_start)] — the same
+        # bucket key as JaxWorker, so the two paths group identically
+        groups: dict[tuple, list[tuple[int, object, float]]] = {}
+        for pos, (lo, hi) in enumerate(segments):
+            t0 = time.perf_counter()
+            if layout.nbits(lo, hi) < MIN_DEVICE_BITS:
+                # sub-word slivers: numpy reference, as process_segment does
+                out[pos] = self._cpu_fallback.process_segment(
+                    lo, hi, seed_primes, seg_ids[pos]
+                )
+                continue
+            with trace.span(
+                "segment.prepare", backend=self.name, seg=seg_ids[pos]
+            ):
+                ts = self._prepare(packing, lo, hi, seed_primes)
+            c_pad = max(1, next_pow2(ts.corr_idx.size))
+            key = (ts.Wpad, ts.periods, ts.m2.size, c_pad)
+            groups.setdefault(key, []).append((pos, ts, t0))
+        twin_kind = pair_kind(self.config)
+        gap = getattr(self.config, "pair_gap", 2) or 2
+        ndev = self.devices
+        for (Wpad, periods, _s2, c_pad), members in groups.items():
+            b = len(members)
+            # pad the batch so every device gets an equal, pow2-bucketed
+            # row count; pad rows recompute member 0 and are discarded
+            b_pad = ndev * next_pow2(-(-b // ndev))
+            rows = [m[1] for m in members] + [members[0][1]] * (b_pad - b)
+            step = _make_cold_step(
+                self._mesh_key, Wpad, twin_kind, periods, ndev
+            )
+            with trace.span(
+                "segment.device", backend=self.name, batch=b,
+                padded=b_pad, devices=ndev,
+            ):
+                packed = np.asarray(step(
+                    np.array([ts.nbits for ts in rows], np.int32),
+                    tuple(
+                        np.stack([ts.patterns[i] for ts in rows])
+                        for i in range(len(periods))
+                    ),
+                    *(
+                        np.stack([getattr(ts, f) for ts in rows])
+                        for f in ("m2", "r2", "K2", "rcp2", "act2")
+                    ),
+                    np.stack([
+                        _pad_to(ts.corr_idx, c_pad, 0) for ts in rows
+                    ]),
+                    np.stack([
+                        _pad_to(ts.corr_mask, c_pad, 0) for ts in rows
+                    ]),
+                    np.array([ts.pair_mask for ts in rows], np.uint32),
+                ))  # uint32[b_pad, 4]: count, pairs, first32, last32
+            self.launches += 1
+            for (pos, ts, t0), row in zip(members, packed[:b]):
+                lo, hi = segments[pos]
+                count, twins, first32, last32 = (int(v) for v in row)
+                count += layout.extras_in(lo, hi)
+                twin_count = (
+                    twins + layout.extra_pairs(lo, hi, gap)
+                    if self.config.twins
+                    else 0
+                )
+                out[pos] = SegmentResult(
+                    seg_id=seg_ids[pos],
+                    lo=lo,
+                    hi=hi,
+                    count=count,
+                    twin_count=twin_count,
+                    first_word=int(first32),
+                    last_word=int(last32),
+                    nbits=ts.nbits,
+                    elapsed_s=time.perf_counter() - t0,
+                )
+        return out

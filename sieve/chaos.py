@@ -130,6 +130,18 @@ Segment store (ISSUE 17; drawn by the tiered segment store on its own
                               re-materialize the chunk — never a crash
                               or a wrong answer.
 
+Mesh cold plane (ISSUE 18; drawn by the ColdBackend on its own
+mesh-launch counter, like the batcher draws batch dispatches):
+
+* ``svc_mesh_fail:any@sK``    the K-th mesh cold dispatch raises inside
+                              the SPMD launch: the whole drain slice is
+                              recomputed on the local loop worker, a
+                              counted ``service_mesh_fallback`` event
+                              fires, and every waiter still gets the
+                              exact answer — the mesh must degrade to
+                              the loop path, never to a wrong answer or
+                              a crash.
+
 Flight recorder (ISSUE 13):
 
 * ``svc_crash:any@sK``        request K's worker thread raises uncaught
@@ -182,6 +194,7 @@ KINDS = (
     "svc_crash",
     "svc_slow_frame",
     "store_torn_write",
+    "svc_mesh_fail",
 )
 # kinds handled by the query service (sieve/service/); the cluster plane
 # ignores these and vice versa. Request-scoped kinds key on the request
@@ -189,7 +202,8 @@ KINDS = (
 # number and is drawn by the LedgerFollower, not the dispatcher;
 # svc_batch_partial keys on the batch-dispatch number and is drawn by
 # the ColdBatcher; store_torn_write keys on the store's append counter
-# and is drawn by the TieredSegmentStore.
+# and is drawn by the TieredSegmentStore; svc_mesh_fail keys on the
+# mesh-launch counter and is drawn by the ColdBackend.
 SERVICE_KINDS = (
     "svc_stall",
     "svc_shed",
@@ -203,6 +217,7 @@ SERVICE_KINDS = (
     "svc_crash",
     "svc_slow_frame",
     "store_torn_write",
+    "svc_mesh_fail",
 )
 SERVICE_REQUEST_KINDS = (
     "svc_stall",
@@ -246,6 +261,7 @@ DEFAULT_PARAM: dict[str, float | str | None] = {
     # param = reply bytes written per event-loop tick on that connection
     "svc_slow_frame": 1.0,
     "store_torn_write": None,
+    "svc_mesh_fail": None,
 }
 
 

@@ -194,6 +194,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
                                          if b != "cpu-cluster"],
                    default="cpu-numpy",
                    help="cold-tier compute backend for uncovered ranges")
+    p.add_argument("--cold-backend", choices=("loop", "mesh"), default=None,
+                   dest="cold_backend",
+                   help="cold-plane dispatch: 'mesh' issues ONE shard_map "
+                        "SPMD launch spanning every device per drain slice "
+                        "(falls back typed to the loop worker when the mesh "
+                        "can't init or a launch fails); 'loop' is the "
+                        "single-worker path (default "
+                        "SIEVE_SVC_COLD_BACKEND/loop)")
     p.add_argument("--queue-limit", type=int, default=None,
                    help="admission queue bound (default SIEVE_SVC_QUEUE/64; "
                         "beyond it requests get a typed overloaded reply)")
@@ -303,6 +311,8 @@ def _serve(argv: list[str]) -> int:
         overrides["persist_cold"] = True
     if args.debug_dir is not None:
         overrides["debug_dir"] = args.debug_dir
+    if args.cold_backend is not None:
+        overrides["cold_backend"] = args.cold_backend
     if procs > 1:
         # child of the --procs supervisor: everyone binds the SAME port
         # via SO_REUSEPORT; only process 0 writes (persist-cold ledger

@@ -11,14 +11,30 @@ double-processing after reassignment cannot double-count.
 
 Wire protocol: the shared length-prefixed JSON framing (sieve/rpc.py,
 also used by the query service). Messages:
-  worker -> coordinator: {"type": "hello", "worker_id": i}
+  worker -> coordinator: {"type": "hello", "worker_id": i, "capacity": c}
                          {"type": "progress", "seg_id", "t_recv", "t_hb"}
                          {"type": "done", "result": SegmentResult dict,
+                          "extras": [SegmentResult dict, ..],
                           "ctx", "t_recv", "t_reply", "telemetry"}
   coordinator -> worker: {"type": "config", "config": .., "seeds": [..]}
                          {"type": "assign", "seg_id", "lo", "hi",
+                          "extra": [{"seg_id", "lo", "hi", "ctx"}, ..],
                           "chaos_die", "run_id", "ctx", "t_send"}
                          {"type": "shutdown"}
+
+Capacity-scaled assignment (ISSUE 18): the hello handshake advertises a
+worker *class* — ``capacity`` is the number of segments the host can
+mark in one launch (device count for mesh/jax workers, 1 for scalar CPU
+workers; ``SIEVE_WORKER_CAPACITY`` overrides). The coordinator sizes
+each assignment with ``_Cluster.assign_batch_size``: capacity is the
+ceiling, and the ramp is seeded from the PR 5 straggler/RTT evidence —
+half the ceiling until at least 4 attempt samples and a clock alignment
+exist, then halved while the projected silent window (p95 × slack ×
+batch) would outrun the deadline budget. Extra segments ride the same
+``assign`` message (``"extra"``) and come back in the same ``done``
+(``"extras"``); the worker computes the whole batch through the
+``process_segments`` seam, so a mesh worker pays ONE SPMD round for the
+lot. Requeue-on-failure covers every in-flight segment of a batch.
 
 Distributed trace plane: every ``assign`` carries a trace context
 (``run_id`` + per-attempt span id ``ctx``) that the worker attaches to
@@ -191,7 +207,12 @@ class _WorkerSession:
         """One connected session; True means explicit shutdown (exit)."""
         from sieve.backends import make_worker
 
-        send_msg(sock, {"type": "hello", "worker_id": self.worker_id})
+        send_msg(sock, {
+            "type": "hello", "worker_id": self.worker_id,
+            # worker class (ISSUE 18): how many segments this host can
+            # mark in one launch; scales coordinator batch sizing
+            "capacity": _worker_capacity(),
+        })
         try:
             msg = recv_msg(sock)
         except socket.timeout:
@@ -260,20 +281,31 @@ class _WorkerSession:
              for c in chaos if c["kind"] == "stall"),
             default=0.0,
         )
+        # capacity batch (ISSUE 18): the assignment may carry extra
+        # segments for a high-capacity worker; the whole batch goes
+        # through the process_segments seam, so a mesh/jax backend pays
+        # one SPMD launch for the lot instead of one per segment
+        batch = [(msg["seg_id"], msg["lo"], msg["hi"])] + [
+            (e["seg_id"], e["lo"], e["hi"]) for e in msg.get("extra") or []
+        ]
         result: list[SegmentResult] = []
         failure: list[str] = []
 
-        def _work(m=msg, ctx=ctx):
+        def _work(m=msg, ctx=ctx, batch=batch):
             try:
-                if env.env_str("SIEVE_CHAOS_RAISE") == str(m["seg_id"]):
+                raise_seg = env.env_str("SIEVE_CHAOS_RAISE")
+                if any(raise_seg == str(sid) for sid, _, _ in batch):
                     raise RuntimeError("chaos: injected segment failure")
                 with trace.span(
                     "worker.segment",
                     seg=m["seg_id"], worker=worker_id, ctx=ctx,
+                    batch=len(batch),
                 ):
-                    result.append(
-                        self.worker.process_segment(
-                            m["lo"], m["hi"], self.seeds, m["seg_id"]
+                    result.extend(
+                        self.worker.process_segments(
+                            [(lo, hi) for _, lo, hi in batch],
+                            self.seeds,
+                            seg_ids=[sid for sid, _, _ in batch],
                         )
                     )
             except Exception as e:  # report, don't die: the coordinator
@@ -306,11 +338,14 @@ class _WorkerSession:
             }
         else:
             res = result[0]
-            reg.counter("worker.segments_done").inc()
-            reg.histogram("worker.segment_ms").observe(
-                round(res.elapsed_s * 1000, 3)
-            )
+            reg.counter("worker.segments_done").inc(len(result))
+            for r in result:
+                reg.histogram("worker.segment_ms").observe(
+                    round(r.elapsed_s * 1000, 3)
+                )
             reply = {"type": "done", "result": res.to_dict()}
+            if len(result) > 1:
+                reply["extras"] = [r.to_dict() for r in result[1:]]
         reply["ctx"] = ctx
         reply["t_recv"] = t_recv
         if self.shipping:
@@ -343,7 +378,38 @@ def _worker_backend() -> str:
         return "cpu-numpy"
 
 
+def _worker_capacity() -> int:
+    """Worker class advertised in the hello handshake (ISSUE 18): the
+    number of segments this host can mark in one launch.
+
+    ``SIEVE_WORKER_CAPACITY`` forces it (operators and tests); otherwise
+    device-backed workers (jax / tpu-pallas / mesh) advertise their
+    device count — one chunk per chip per SPMD round — and scalar CPU
+    workers advertise 1, which keeps the coordinator's sizing identical
+    to the pre-capacity protocol for a classic fleet."""
+    forced = env.env_int("SIEVE_WORKER_CAPACITY", 0)
+    if forced > 0:
+        return forced
+    if _worker_backend() in ("jax", "tpu-pallas", "mesh"):
+        try:
+            import jax
+
+            return max(1, jax.device_count())
+        except Exception:
+            return 1
+    return 1
+
+
 # --- coordinator role --------------------------------------------------------
+
+
+def _ctx_of(current) -> str | None:
+    """Primary trace context of an in-flight assignment (batch or single)."""
+    if not current:
+        return None
+    if isinstance(current, list):
+        return current[0][3]
+    return current[3]
 
 
 # Per-worker clock-offset estimation moved to trace.ClockAlign so the
@@ -366,9 +432,10 @@ class _WorkerConn(threading.Thread):
 
     def run(self) -> None:
         cl = self.cluster
-        # (seg_id, lo, hi, ctx): the in-flight assignment + its trace
-        # context, so failure events correlate with the timeline
-        current: tuple[int, int, int, str] | None = None
+        # [(seg_id, lo, hi, ctx), ..]: the in-flight assignment batch +
+        # per-segment trace contexts, so failure events correlate with
+        # the timeline and a dead worker requeues its WHOLE batch
+        current: list[tuple[int, int, int, str]] | None = None
         joined = False
         leave_reason = "run complete"
         try:
@@ -377,6 +444,9 @@ class _WorkerConn(threading.Thread):
             if not hello or hello["type"] != "hello":
                 return
             self.worker_id = hello["worker_id"]
+            # worker class (ISSUE 18): absent on old workers -> 1, which
+            # reproduces the classic one-segment-per-RPC protocol
+            cl.set_capacity(self.worker_id, hello.get("capacity", 1))
             send_msg(
                 self.sock,
                 {
@@ -399,13 +469,34 @@ class _WorkerConn(threading.Thread):
                     continue
                 if seg.seg_id in cl.done:
                     continue
+                # capacity-scaled batch (ISSUE 18): a high-capacity
+                # worker (mesh/jax host) pulls extra segments so one RPC
+                # round feeds every chip; get_nowait never blocks, so a
+                # thin queue degrades to the classic one-segment assign
+                segs = [seg]
+                want = cl.assign_batch_size(self.worker_id)
+                while len(segs) < want:
+                    try:
+                        nxt = cl.queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt.seg_id in cl.done:
+                        continue
+                    segs.append(nxt)
                 # per-attempt span id: rpc.assign here and worker.segment
                 # over there carry the same ctx, so the merged trace (and
                 # reassignments of the same segment) correlate exactly
-                attempt = cl.attempts.get(seg.seg_id, 0)
-                ctx = f"{cl.run_id}/{seg.seg_id}.{attempt}"
-                current = (seg.seg_id, seg.lo, seg.hi, ctx)
-                chaos = cl.chaos.take(self.worker_id, seg.seg_id)
+                current = []
+                for s in segs:
+                    attempt = cl.attempts.get(s.seg_id, 0)
+                    current.append(
+                        (s.seg_id, s.lo, s.hi,
+                         f"{cl.run_id}/{s.seg_id}.{attempt}")
+                    )
+                ctx = current[0][3]
+                chaos = []
+                for s in segs:
+                    chaos.extend(cl.chaos.take(self.worker_id, s.seg_id))
                 # adaptive silence deadline: any message (heartbeat or
                 # reply) refreshes it via settimeout-per-recv, so only a
                 # *silent* worker can breach it
@@ -427,6 +518,10 @@ class _WorkerConn(threading.Thread):
                         "run_id": cl.run_id,
                         "ctx": ctx,
                         "t_send": t_assign,
+                        "extra": [
+                            {"seg_id": sid, "lo": lo, "hi": hi, "ctx": c}
+                            for sid, lo, hi, c in current[1:]
+                        ],
                     },
                 )
                 while True:
@@ -481,6 +576,7 @@ class _WorkerConn(threading.Thread):
                             seg=seg.seg_id,
                             ctx=ctx,
                             outcome=msg["type"],
+                            batch=len(current),
                         )
                         reg.histogram("cluster.rpc_ms").observe(
                             inflight * 1000
@@ -489,12 +585,17 @@ class _WorkerConn(threading.Thread):
                             f"cluster.worker{self.worker_id}.inflight_s"
                         ).set(0.0)
                     if msg["type"] == "done":
-                        cl.observe_attempt(inflight)
+                        # per-segment duration feeds the deadline model:
+                        # a batched round is one wire round-trip but
+                        # len(current) segments of compute
+                        cl.observe_attempt(inflight / max(1, len(current)))
                         cl.complete(SegmentResult.from_dict(msg["result"]))
+                        for r in msg.get("extras") or []:
+                            cl.complete(SegmentResult.from_dict(r))
                         current = None
                         break
                     if msg["type"] == "error":
-                        cl.observe_attempt(inflight)
+                        cl.observe_attempt(inflight / max(1, len(current)))
                         cl.segment_error(current, msg["error"])
                         current = None
                         break
@@ -546,6 +647,9 @@ class _Cluster:
         self._attempt_s: collections.deque = collections.deque(maxlen=256)
         self._deadline_last: float | None = None
         self._active_workers = 0
+        # worker class from the hello handshake (ISSUE 18): ceiling for
+        # assign_batch_size, per connected worker id
+        self.worker_capacity: dict[int, int] = {}  # guard: lock
         self.joins = 0
         self.leaves = 0
         for seg in segments:
@@ -582,6 +686,45 @@ class _Cluster:
         trace.instant(
             "cluster.worker_left", worker=worker_id, active=active
         )
+
+    def set_capacity(self, worker_id: int, capacity) -> None:
+        """Record a worker's advertised class from the hello handshake."""
+        try:
+            cap = max(1, int(capacity))
+        except (TypeError, ValueError):
+            cap = 1  # malformed hello never breaks assignment
+        with self.lock:
+            self.worker_capacity[worker_id] = cap
+        registry().gauge(f"cluster.worker{worker_id}.capacity").set(cap)
+
+    def assign_batch_size(self, worker_id: int) -> int:
+        """Segments per assignment for ``worker_id`` (ISSUE 18).
+
+        Capacity — the worker's advertised device count — is the
+        ceiling: a mesh-backed host marks ``capacity`` chunks in one
+        SPMD launch, so handing it fewer wastes chips. The ramp is
+        seeded from the PR 5 straggler/RTT evidence: with under 4
+        attempt samples or no clock alignment yet, hand out half the
+        ceiling (a misadvertised fat worker cannot starve the queue
+        before the model has data); once evidence exists, halve the
+        batch while the projected silent window (p95 × slack × batch)
+        would exceed the deadline budget (static floor vs 8× min-RTT),
+        so batching never outruns the straggler detector."""
+        with self.lock:
+            cap = self.worker_capacity.get(worker_id, 1)
+            samples = sorted(self._attempt_s)
+        if cap <= 1:
+            return 1
+        align = self.clock.get(worker_id)
+        if len(samples) < 4 or align is None or not align.samples:
+            return max(1, cap // 2)
+        slack = env.env_float("SIEVE_CLUSTER_DEADLINE_SLACK", 4.0)
+        p95 = samples[min(len(samples) - 1, math.ceil(0.95 * len(samples)) - 1)]
+        budget = max(_base_deadline_s(), align.rtt_s * 8)
+        batch = cap
+        while batch > 1 and p95 * slack * batch > budget:
+            batch //= 2
+        return max(1, batch)
 
     def observe_attempt(self, dur_s: float) -> None:
         """Feed one completed assignment's duration to the deadline model."""
@@ -680,7 +823,7 @@ class _Cluster:
         registry().counter("cluster.worker_failures").inc()
         self.metrics.event(
             "worker_failed", worker=worker_id, reason=reason,
-            run_id=self.run_id, ctx=current[3] if current else None,
+            run_id=self.run_id, ctx=_ctx_of(current),
         )
         self._requeue(current, reason)
 
@@ -690,12 +833,20 @@ class _Cluster:
         registry().counter("cluster.segment_errors").inc()
         self.metrics.event(
             "segment_error", reason=reason.splitlines()[0],
-            run_id=self.run_id, ctx=current[3] if current else None,
+            run_id=self.run_id, ctx=_ctx_of(current),
         )
         self._requeue(current, reason)
 
     def _requeue(self, current, reason: str) -> None:
         if current is None:
+            return
+        if isinstance(current, list):
+            # capacity batch (ISSUE 18): every in-flight segment of a
+            # failed batched assignment goes back, each with its own
+            # attempt count — one flaky fat worker costs one strike per
+            # segment, exactly like n sequential failures would
+            for item in current:
+                self._requeue(item, reason)
             return
         seg_id, lo, hi, ctx = current
         with self.lock:

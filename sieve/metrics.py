@@ -154,6 +154,15 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     "store_demoted": {"lo", "hi", "bytes", "tier"},
     "store_compacted": {"gen", "live", "reclaimed_bytes", "downgraded"},
     "store_torn_entry": {"offset", "gen"},
+    # mesh cold plane (ISSUE 18): service_mesh_dispatch is one SPMD
+    # launch over the device mesh ("chunks" = drain-slice fanout,
+    # "launch" = the ColdBackend's mesh-launch counter — the
+    # svc_mesh_fail chaos key); service_mesh_fallback is a typed
+    # degradation to the local loop worker ("reason" names mesh init vs
+    # launch failure; chunks=0 for the one-shot init fallback) — the
+    # answers stay exact either way.
+    "service_mesh_dispatch": {"chunks", "devices", "launch", "ms"},
+    "service_mesh_fallback": {"reason", "chunks"},
 }
 
 

@@ -41,6 +41,7 @@ from sieve.kernels.jax_mark import (
     WORD_BUCKET,
     mark_words_impl,
     next_pow2,
+    pack4,
 )
 from sieve.kernels.specs import TieredChain
 from sieve.metrics import MetricsLogger
@@ -192,6 +193,47 @@ def _make_step(mesh_key, Wpad: int, twin_kind: int, periods: tuple, ndev: int):
         P("seg"), P("seg"),          # pair_mask, gap_ok
     )
     out_specs = P()  # one packed replicated vector (see _collective_merge)
+    return _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_cold_step(mesh_key, Wpad: int, twin_kind: int, periods: tuple,
+                    ndev: int):
+    """Jitted SPMD step for the service cold plane (ISSUE 18): a batch of
+    B independent drained chunks (B a multiple of ndev) is sharded over
+    the 'seg' axis, each device vmaps the word kernel over its B/ndev
+    rows, and the packed uint32[B, 4] result rides back row-sharded — no
+    collectives, because cold chunks are independent queries, not one
+    contiguous range. One launch per drain slice replaces K sequential
+    markings; cached per (mesh, Wpad, periods, batch-shape) bucket via
+    the arrays' leading dim."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _MESHES[mesh_key]
+    smap = _shard_map()
+
+    def one(nbits, patterns, m2, r2, K2, rcp2, act2, ci, cm, pmask):
+        return pack4(*mark_words_impl(
+            Wpad, twin_kind, periods, nbits, patterns,
+            m2, r2, K2, rcp2, act2, ci, cm, pmask,
+        ))
+
+    def shard_fn(nbits, patterns, m2, r2, K2, rcp2, act2, ci, cm, pmask):
+        # per-device sub-batch [B/ndev, ...] -> uint32[B/ndev, 4]
+        return jax.vmap(one)(
+            nbits, patterns, m2, r2, K2, rcp2, act2, ci, cm, pmask
+        )
+
+    n_pat = len(periods)
+    in_specs = (
+        P("seg"),                    # nbits
+        (P("seg"),) * n_pat,         # patterns
+        P("seg"), P("seg"), P("seg"), P("seg"), P("seg"),  # tier-2
+        P("seg"), P("seg"),          # corrections
+        P("seg"),                    # pair_mask
+    )
+    out_specs = P("seg")  # uint32[B, 4], rows in batch order
     return _jit_sharded(smap, shard_fn, mesh, in_specs, out_specs)
 
 

@@ -119,9 +119,11 @@ from sieve.checkpoint import (
     LedgerMismatch,
     ledger_fingerprint,
 )
+from sieve.bitset import get_layout
 from sieve.enumerate import MAX_HI, primes_in_range
 from sieve.metrics import MetricsHistory, MetricsLogger, registry, sample_interval_s
-from sieve.service.store import StoreSettings, TieredSegmentStore
+from sieve.service.store import TIER_BOUNDARY, StoreSettings, TieredSegmentStore
+from sieve.worker import SegmentResult
 from sieve.rpc import (
     SUPPORTED_WIRE,
     WIRE_V1,
@@ -326,6 +328,12 @@ class ServiceSettings:
     # _MIN_COMPACT_BYTES / _T2_BYTES / _REFRESH_S) are read by
     # sieve.service.store.StoreSettings.from_env.
     store: bool = True
+    # mesh-backed cold plane (ISSUE 18): "mesh" dispatches each cold
+    # drain slice as ONE shard_map/jit SPMD launch spanning every device
+    # (sieve/backends/mesh_backend.py); "loop" is the classic
+    # single-worker path. Mesh init or launch failure falls back to the
+    # loop worker — typed (event + counter), never a wrong answer.
+    cold_backend: str = "loop"
 
     def validate(self) -> "ServiceSettings":
         """Typed startup validation: every rejection names the setting
@@ -414,6 +422,11 @@ class ServiceSettings:
                 f"service settings: debug_dir={self.debug_dir!r} must be a "
                 "non-empty path (or None)"
             )
+        if self.cold_backend not in ("loop", "mesh"):
+            raise ValueError(
+                f"service settings: cold_backend={self.cold_backend!r} "
+                "must be 'loop' or 'mesh'"
+            )
         if self.slo_ms is not None:
             if not isinstance(self.slo_ms, dict):
                 raise ValueError(
@@ -491,6 +504,9 @@ class ServiceSettings:
             procs=_env_int("SIEVE_SVC_PROCS", cls.procs),
             reuse_port=_env_bool("SIEVE_SVC_REUSE_PORT", "0"),
             store=_env_bool("SIEVE_STORE", "1"),
+            cold_backend=(
+                env.env_str("SIEVE_SVC_COLD_BACKEND") or cls.cold_backend
+            ),
         )
         return dataclasses.replace(s, **overrides)
 
@@ -508,7 +524,7 @@ class ColdBackend:
     """
 
     def __init__(self, config: "SieveConfig", settings: ServiceSettings,
-                 on_transition=None):
+                 on_transition=None, chaos=None, events=None, bump=None):
         self.config = config
         self.settings = settings
         self._worker = None  # guard: _lock — lazy; a cold-only
@@ -520,6 +536,27 @@ class ColdBackend:
         self._down_reason = ""  # guard: _state_lock
         self._degraded = False  # guard: _state_lock
         self._on_transition = on_transition or (lambda entering, reason: None)
+        # mesh cold plane (ISSUE 18): lazy MeshWorker + typed fallback
+        # bookkeeping. A failed mesh INIT is permanent for this process
+        # (config/host problem — retrying per drain would pay the failed
+        # device probe on every dispatch); a failed LAUNCH falls back
+        # per-batch and the next drain tries the mesh again.
+        self._mesh_worker = None  # guard: none(written under _lock only;
+        # set-once None->worker, lock-free describe() reads are racy-ok)
+        self._mesh_failed = None  # guard: none(written under _lock only;
+        # set-once None->reason, lock-free describe() reads are racy-ok)
+        # observability counters: written only under _lock (count_ranges
+        # is the single writer); describe() snapshots them lock-free so
+        # stats/health never block behind a long cold dispatch
+        self.mesh_launches = 0  # guard: none(written under _lock only;
+        # lock-free reads are racy-ok monotonic snapshots)
+        self.mesh_fallbacks = 0  # guard: none(written under _lock only;
+        # lock-free reads are racy-ok monotonic snapshots)
+        self.last_fanout = 0  # guard: none(written under _lock only;
+        # lock-free reads are racy-ok snapshots)
+        self._chaos = chaos  # injected schedule (svc_mesh_fail draws)
+        self._event = events or (lambda kind, **fields: None)
+        self._bump = bump or (lambda key, n=1: None)
 
     def force_down(self, secs: float, reason: str) -> None:
         """Chaos/backend_down: report down for ``secs`` from now."""
@@ -556,6 +593,88 @@ class ColdBackend:
         """Exact primes in [lo, hi) via the backend, or raise Degraded."""
         return int(self.count_ranges([(lo, hi)])[0].count)
 
+    def describe(self) -> dict:
+        """Cold-plane identity for stats/health/fleet_top (ISSUE 18):
+        the effective backend class, mesh device count, and the last
+        drain's chunk fanout — a misconfigured mesh replica (0 devices,
+        'loop (mesh failed)') is visible at a glance. Lock-free: these
+        are racy-ok snapshots of counters written under _lock."""
+        worker = self._mesh_worker
+        if worker is not None:
+            klass, devices = "mesh", worker.devices
+        elif self._mesh_failed is not None:
+            klass, devices = "loop (mesh failed)", 0
+        else:
+            klass, devices = self.settings.cold_backend, 0
+        return {
+            "cold_backend": klass,
+            "mesh_devices": devices,
+            "mesh_fanout": self.last_fanout,
+        }
+
+    def _mesh_locked(self):
+        """Lazily build the MeshWorker. A failed init falls back typed
+        (event + counter) ONCE and is then permanent for this process —
+        it's a config/host problem, and retrying would pay the failed
+        device probe on every drain. Caller holds ``_lock``."""
+        if self._mesh_worker is not None:
+            return self._mesh_worker
+        if self._mesh_failed is not None:
+            return None
+        try:
+            from sieve.backends.mesh_backend import MeshWorker
+
+            self._mesh_worker = MeshWorker(self.config)
+        except Exception as e:
+            self._mesh_failed = f"mesh init failed: {e}"
+            self.mesh_fallbacks += 1
+            self._bump("mesh_fallbacks")
+            self._event(
+                "service_mesh_fallback", reason=self._mesh_failed, chunks=0
+            )
+            return None
+        return self._mesh_worker
+
+    def _mesh_dispatch(self, mesh, chunks, seeds, seg_ids):
+        """ONE SPMD launch for the drain slice (ISSUE 18). Returns None
+        on launch failure — the caller recomputes the same batch on the
+        loop worker, so waiters always get exact answers and the
+        degradation is typed (``service_mesh_fallback`` + counter), never
+        a wrong answer or a crash. Caller holds ``_lock``."""
+        self.mesh_launches += 1
+        launch = self.mesh_launches
+        t0 = trace.now_s()
+        try:
+            with trace.span(
+                "query.cold_mesh", chunks=len(chunks),
+                devices=mesh.devices, launch=launch,
+            ):
+                if self._chaos is not None and self._chaos.take_kinds(
+                    0, launch, ("svc_mesh_fail",)
+                ):
+                    raise RuntimeError(
+                        f"chaos svc_mesh_fail: mesh cold dispatch {launch}"
+                    )
+                results = mesh.process_segments(
+                    chunks, seeds, seg_ids=seg_ids
+                )
+        except Exception as e:
+            self.mesh_fallbacks += 1
+            self._bump("mesh_fallbacks")
+            self._event(
+                "service_mesh_fallback",
+                reason=f"mesh launch failed: {e}", chunks=len(chunks),
+            )
+            return None
+        self.last_fanout = len(chunks)
+        self._bump("mesh_launches")
+        self._event(
+            "service_mesh_dispatch", quietable=True, chunks=len(chunks),
+            devices=mesh.devices, launch=launch,
+            ms=round((trace.now_s() - t0) * 1e3, 3),
+        )
+        return results
+
     def count_ranges(self, chunks: list[tuple[int, int]]):
         """One backend dispatch for a sorted list of disjoint chunks
         (ISSUE 9): returns a :class:`~sieve.worker.SegmentResult` per
@@ -582,18 +701,30 @@ class ColdBackend:
                     "query.cold", lo=chunks[0][0], hi=chunks[-1][1],
                     chunks=len(chunks),
                 ):
-                    batch = getattr(self._worker, "process_segments", None)
-                    if batch is None:
-                        # minimal worker stubs (tests) expose only the
-                        # single-segment seam; loop it
-                        results = [
-                            self._worker.process_segment(
-                                lo, hi, seeds, seg_id=sid
+                    results = None
+                    if self.settings.cold_backend == "mesh":
+                        mesh = self._mesh_locked()
+                        if mesh is not None:
+                            # None -> typed fallback: the loop path below
+                            # recomputes the same batch bit-exactly
+                            results = self._mesh_dispatch(
+                                mesh, chunks, seeds, seg_ids
                             )
-                            for (lo, hi), sid in zip(chunks, seg_ids)
-                        ]
-                    else:
-                        results = batch(chunks, seeds, seg_ids=seg_ids)
+                    if results is None:
+                        batch = getattr(
+                            self._worker, "process_segments", None
+                        )
+                        if batch is None:
+                            # minimal worker stubs (tests) expose only the
+                            # single-segment seam; loop it
+                            results = [
+                                self._worker.process_segment(
+                                    lo, hi, seeds, seg_id=sid
+                                )
+                                for (lo, hi), sid in zip(chunks, seg_ids)
+                            ]
+                        else:
+                            results = batch(chunks, seeds, seg_ids=seg_ids)
             for res in results:
                 if not res.is_sane():
                     raise RuntimeError(
@@ -623,6 +754,9 @@ class ColdBackend:
             if self._worker is not None:
                 self._worker.close()
                 self._worker = None
+            if self._mesh_worker is not None:
+                self._mesh_worker.close()
+                self._mesh_worker = None
 
 
 class _Flight:
@@ -735,6 +869,32 @@ class ColdBatcher:
                 ))
             else:
                 good.append(key)
+        n_failed = len(batch) - len(good)
+        # tier-1 restart-hot (ISSUE 18): a chunk whose boundary entry a
+        # previous incarnation persisted through the store answers from
+        # disk — no re-marking across restarts. Only boundary-or-richer
+        # tiers qualify (counts alone can't rebuild a SegmentResult).
+        if good:
+            hits: list[tuple[tuple[int, int], SegmentResult]] = []
+            misses: list[tuple[int, int]] = []
+            for key in good:
+                res = svc._store_cold_result(key)
+                if res is None:
+                    misses.append(key)
+                else:
+                    hits.append((key, res))
+            if hits:
+                svc._bump("cold_store_hits", len(hits))
+                with svc._cold_lock:
+                    for _key, res in hits:
+                        svc._cold_cache[(res.lo, res.hi)] = res
+                        svc._cold_cache.move_to_end((res.lo, res.hi))
+                    while (len(svc._cold_cache)
+                           > svc.settings.cold_cache_entries):
+                        svc._cold_cache.popitem(last=False)
+                for key, res in hits:
+                    self._resolve(key, res, None)
+            good = misses
         persisted = 0
         if good:
             svc._bump("cold_dispatches")
@@ -763,7 +923,7 @@ class ColdBatcher:
         svc.metrics.event(
             "service_batched", quietable=True, chunks=len(good),
             lo=batch[0][0], hi=batch[-1][1], ms=ms,
-            persisted=persisted, failed=len(batch) - len(good),
+            persisted=persisted, failed=n_failed,
         )
 
     def _resolve(self, key, result, error) -> None:
@@ -923,6 +1083,9 @@ _STATS = (
     "cold_dispatches",
     "cold_batched_chunks",
     "cold_persisted",
+    "cold_store_hits",
+    "mesh_launches",
+    "mesh_fallbacks",
     "coalesced",
     "shed",
     "hot_admitted",
@@ -1062,7 +1225,10 @@ class SieveService:
         # svc-follower)
         self.follower: LedgerFollower | None = None  # guard: none(set
         # once in start(); readers null-check)
-        self.cold = ColdBackend(config, self.settings, self._on_degraded)
+        self.cold = ColdBackend(
+            config, self.settings, self._on_degraded,
+            chaos=self.chaos, events=self.metrics.event, bump=self._bump,
+        )
         self._cold_lock = named_lock("SieveService._cold_lock")
         # LRU of chunk results, most-recent at the end: O(1) hit
         # (move_to_end) and O(1) eviction (popitem(last=False)) — the
@@ -1489,6 +1655,9 @@ class SieveService:
         out["snapshot_age_s"] = round(trace.now_s() - self._snapshot_ts, 3)
         out["draining"] = self._draining
         out["persist_cold"] = self._writer is not None
+        # cold-plane identity (ISSUE 18): effective backend class, mesh
+        # device count, last drain's chunk fanout — lock-free snapshot
+        out.update(self.cold.describe())
         out["range_lo"] = self.base
         out["procs"] = self.settings.procs
         out["proc_index"] = self.settings.proc_index
@@ -1837,6 +2006,9 @@ class SieveService:
                 # inline on the wire loop, unlike the blocking store ops
                 "store": (self.store.health()
                           if self.store is not None else None),
+                # cold-plane identity (ISSUE 18) — describe() is
+                # lock-free, so inline on the wire loop is safe
+                **self.cold.describe(),
             }, front=True)
             return None
         if mtype == "stats":
@@ -2838,8 +3010,44 @@ class SieveService:
         except Exception:  # noqa: BLE001 — persistence never fails queries
             registry().counter("service.persist_failed").inc()
             return 0
+        # tier-1 store entries (ISSUE 18): boundary words, not just
+        # counts — the restart-hot half of --persist-cold. Keyed on the
+        # exact (lo, hi) chunk, so clipped chunks persist independently
+        # of the grid chunk sharing their seg_id. Best-effort, like the
+        # ledger write above. ALL results qualify (not just `keep`): a
+        # clipped chunk is an exact fact even when the ledger already
+        # covers a larger hi for its seg_id.
+        if self.store is not None and self.store.writer:
+            try:
+                for r in results:
+                    self.store.put_boundary(
+                        r.lo, r.hi, r.count, r.first_word, r.last_word
+                    )
+            except Exception:  # noqa: BLE001
+                registry().counter("service.persist_failed").inc()
         self._bump("cold_persisted", len(keep))
         return len(keep)
+
+    def _store_cold_result(self, key: tuple[int, int]):
+        """Rebuild a cold chunk's SegmentResult from a persisted tier-1+
+        store entry (ISSUE 18 restart-hot), or None. Tier 0 can't
+        qualify — counts alone lack the boundary words downstream merges
+        read — and pair-counting configs recompute: the store header has
+        no twin_count field, so a synthesized result could carry a wrong
+        one."""
+        if self.store is None or self.config.twins:
+            return None
+        ent = self.store.get_entry(*key)
+        if ent is None or ent[0] < TIER_BOUNDARY:
+            return None
+        tier, count, fw, lw = ent
+        lo, hi = key
+        return SegmentResult(
+            seg_id=COLD_SEG_BASE + lo, lo=lo, hi=hi, count=int(count),
+            twin_count=0, first_word=int(fw), last_word=int(lw),
+            nbits=get_layout(self.config.packing).nbits(lo, hi),
+            elapsed_s=0.0,
+        )
 
 
 def _grid_next(a: int, chunk: int) -> int:

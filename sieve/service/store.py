@@ -456,6 +456,22 @@ class TieredSegmentStore:
                 return
             self._append_locked((lo, hi), TIER_COUNT, count, 0, 0, 0, b"")
 
+    def put_boundary(self, lo: int, hi: int, count: int,
+                     first_word: int, last_word: int) -> bool:
+        """Tier-1 fact (ISSUE 18): count plus the exact boundary flag
+        words — what ``--persist-cold`` records per cold chunk so a
+        restarted server can rebuild the chunk's full SegmentResult
+        without re-marking it. Skipped when a boundary-or-richer entry
+        already exists (never shadow richer data with a re-persist);
+        returns False on a duplicate or a chaos-torn write."""
+        with self._lock:
+            cur = self._entries.get((lo, hi))
+            if cur is not None and cur.tier >= TIER_BOUNDARY:
+                return False
+            return self._append_locked(
+                (lo, hi), TIER_BOUNDARY, int(count),
+                int(first_word), int(last_word), 0, b"")
+
     def put_flags(self, lo: int, hi: int, flags: np.ndarray,
                   layout: Layout) -> bool:
         """Demote a fully-sieved flag array into tier 2. The flag bits

@@ -155,21 +155,24 @@ def test_health_and_stats_key_schema_snapshot(service):
     svc, cli = service
     assert cli.pi(30_000) == o_pi(30_000)
     assert sorted(cli.health()) == [
-        "brownout", "covered_hi", "draining", "id", "ok", "proc",
+        "brownout", "cold_backend", "covered_hi", "draining", "id",
+        "mesh_devices", "mesh_fanout", "ok", "proc",
         "queue_depth", "queue_depth_cold", "queue_depth_hot", "range_lo",
         "refreshes", "snapshot_age_s", "status", "store", "total_primes",
         "type",
     ]
     assert sorted(cli.stats()) == [
         "bad_requests", "batch_members", "batch_requests", "brownout",
-        "coalesced", "cold_admitted",
+        "coalesced", "cold_admitted", "cold_backend",
         "cold_batched_chunks", "cold_cache_hits", "cold_computes",
-        "cold_dispatches", "cold_persisted", "covered_hi",
+        "cold_dispatches", "cold_persisted", "cold_store_hits",
+        "covered_hi",
         "deadline_exceeded", "degraded", "degraded_replies", "demoted",
         "draining", "draining_replies", "dropped_segments",
         "hot_admitted", "hot_workers_dedicated", "index_hits",
         "internal_errors", "lane_shed_cold", "lane_shed_hot",
-        "lru_entries", "lru_hits", "materialized", "persist_cold",
+        "lru_entries", "lru_hits", "materialized", "mesh_devices",
+        "mesh_fallbacks", "mesh_fanout", "mesh_launches", "persist_cold",
         "proc_index", "procs", "queue_depth", "queue_depth_cold",
         "queue_depth_hot", "range_lo", "refresh_attempts",
         "refresh_failed", "refreshes", "requests", "segments", "shed",

@@ -1,0 +1,411 @@
+"""The mesh-backed cold compute plane (ISSUE 18).
+
+Covers: ``MeshWorker.process_segments`` bit-exact against the
+``CpuNumpyWorker`` reference across all three packings (sub-word
+slivers fall back, pad rows are masked on every launch); a 20-thread
+cold burst on ``--cold-backend mesh`` costing one SPMD round per drain
+slice with every reply oracle-exact and bit-identical to the loop
+backend; the ``svc_mesh_fail`` chaos kind degrading to the typed local
+fallback with exact answers; capacity-scaled cluster assignment (the
+hello ``capacity`` field, the evidence-gated ``assign_batch_size``
+ramp, and an end-to-end capacity-4 run); ``--persist-cold`` tier-1
+boundary facts answering a restarted server out of the segment store
+(``cold_store_hits``) with zero re-marking; the stats/health/fleet-top
+cold-backend surfaces; the trace_report ``cold mesh`` latency row; and
+the tools/mesh_cold_smoke.py subprocess gate.
+"""
+
+import math
+import shutil
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sieve import metrics
+from sieve.backends.cpu_numpy import CpuNumpyWorker
+from sieve.backends.mesh_backend import MeshWorker, mesh_device_count
+from sieve.chaos import ANY_WORKER, parse_chaos
+from sieve.cluster import _Cluster, _worker_capacity, run_cluster
+from sieve.config import SieveConfig
+from sieve.coordinator import run_local
+from sieve.metrics import MemorySink, MetricsLogger, validate_record
+from sieve.seed import seed_primes
+from sieve.service import ServiceClient, ServiceSettings, SieveService
+from sieve.trace import ClockAlign
+
+REPO = Path(__file__).resolve().parent.parent
+N = 50_000
+PACKINGS = ["plain", "odds", "wheel30"]
+
+# mixed spans and alignments: a sub-word sliver (CPU fallback inside a
+# mesh batch), unaligned bounds, and equal-span chunks that land in one
+# shape group — 5 rows on an 8-device mesh, so every launch pads and
+# must mask the pad rows exactly
+SEGMENTS = [
+    (2, 40),
+    (1_000, 9_000),
+    (9_000, 17_192),
+    (60_000, 68_192),
+    (68_192, 76_384),
+]
+
+P = seed_primes(200_000)
+
+
+def o_pi(x):
+    return int(np.searchsorted(P, x, side="right"))
+
+
+def o_count(lo, hi):
+    return int(np.searchsorted(P, hi, side="left")
+               - np.searchsorted(P, lo, side="left"))
+
+
+def _fields(res) -> tuple:
+    # everything but elapsed_s (wall time differs between paths)
+    return (res.seg_id, res.lo, res.hi, res.count, res.twin_count,
+            res.first_word, res.last_word, res.nbits)
+
+
+@pytest.fixture
+def memsink():
+    sink = MemorySink()
+    metrics.add_sink(sink)
+    yield sink
+    metrics.remove_sink(sink)
+
+
+@pytest.fixture(scope="module")
+def ledger_dir(tmp_path_factory):
+    path = tmp_path_factory.mktemp("mesh_ledger")
+    run_local(_cfg(str(path)))
+    return path
+
+
+def _cfg(checkpoint_dir: str, **kw) -> SieveConfig:
+    base = dict(
+        n=N, backend="cpu-numpy", packing="wheel30", n_segments=4,
+        quiet=True, checkpoint_dir=checkpoint_dir,
+    )
+    base.update(kw)
+    return SieveConfig(**base)
+
+
+def _settings(**kw) -> ServiceSettings:
+    base = dict(
+        workers=4, queue_limit=32, default_deadline_s=10.0,
+        cold_chunk=1 << 16, refresh_s=0.0, cold_backend="mesh",
+    )
+    base.update(kw)
+    return ServiceSettings(**base)
+
+
+# --- MeshWorker parity (satellite c) -----------------------------------------
+
+
+@pytest.mark.parametrize("twins", [False, True])
+@pytest.mark.parametrize("packing", PACKINGS)
+def test_mesh_matches_cpu_reference(packing, twins):
+    cfg = SieveConfig(n=100_000, backend="cpu-numpy", packing=packing,
+                      twins=twins, quiet=True)
+    mesh = MeshWorker(cfg)
+    ref = CpuNumpyWorker(cfg)
+    seeds = seed_primes(math.isqrt(max(hi for _, hi in SEGMENTS) - 1))
+    sids = [100 + i for i in range(len(SEGMENTS))]
+    got = mesh.process_segments(SEGMENTS, seeds, seg_ids=sids)
+    for (lo, hi), sid, res in zip(SEGMENTS, sids, got):
+        want = ref.process_segment(lo, hi, seeds, seg_id=sid)
+        assert _fields(res) == _fields(want), (packing, twins, lo, hi)
+    # the sliver went to the CPU fallback; everything else rode the mesh
+    assert mesh.launches >= 1
+    assert mesh.devices == mesh_device_count()
+    mesh.close()
+    ref.close()
+
+
+def test_mesh_pad_masking_batch_larger_than_mesh():
+    # 9 equal-span chunks on an 8-device mesh: b_pad = 16, seven pad
+    # rows recomputing row 0 — none of them may leak into the output
+    cfg = SieveConfig(n=200_000, backend="cpu-numpy", packing="odds",
+                      quiet=True)
+    span = 1 << 13  # grid ends at 133_728, inside the P oracle
+    segs = [(60_000 + i * span, 60_000 + (i + 1) * span) for i in range(9)]
+    mesh = MeshWorker(cfg)
+    launches0 = mesh.launches
+    got = mesh.process_segments(segs, P)
+    # one launch per shape group, never one per chunk (shallow chunks
+    # near the seed-tier boundary may split into a second group)
+    assert 1 <= mesh.launches - launches0 <= 2
+    for (lo, hi), res in zip(segs, got):
+        assert res.count == o_count(lo, hi)
+    mesh.close()
+
+
+# --- service burst: one SPMD round per drain slice (tentpole) ----------------
+
+
+def test_mesh_cold_burst_one_round_per_drain(ledger_dir, memsink):
+    # covered prefix ends at 50_001; the two targets need exactly 3
+    # distinct chunk keys — a 20-thread burst must drain in <= 3
+    # dispatches (<= ceil(K / batch_max_chunks) per slice), each mesh
+    # dispatch ONE SPMD round per shape group
+    settings = _settings(workers=8, cold_delay_s=0.25)
+    targets = [90_000, 120_000] * 10  # 20 overlapping cold queries
+    with SieveService(_cfg(str(ledger_dir)), settings) as svc:
+        got, errs = [], []
+
+        def q(x):
+            try:
+                with ServiceClient(svc.addr, timeout_s=30) as c:
+                    got.append((x, c.pi(x)))
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=q, args=(x,)) for x in targets]
+        threads[0].start()
+        time.sleep(0.05)  # inside the first dispatch's delay window
+        for t in threads[1:]:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errs
+        assert sorted(got) == sorted((x, o_pi(x)) for x in targets)
+        with ServiceClient(svc.addr) as cli:
+            s = cli.stats()
+            h = cli.health()
+        assert 1 <= s["cold_dispatches"] <= 3
+        assert s["mesh_fallbacks"] == 0
+        # every dispatched slice was a mesh round: one launch per shape
+        # group per drain, never one per chunk
+        assert 1 <= s["mesh_launches"] <= 2 * s["cold_dispatches"]
+        assert s["mesh_launches"] < 3 * len(set(targets))
+        # stats/health expose the cold worker class (satellite f)
+        for out in (s, h):
+            assert out["cold_backend"] == "mesh"
+            assert out["mesh_devices"] == mesh_device_count()
+            assert out["mesh_fanout"] >= 1
+    ev = [x for x in memsink.records
+          if x["event"] == "service_mesh_dispatch"]
+    assert ev and all(x["devices"] == mesh_device_count() for x in ev)
+    for x in ev:
+        validate_record(x)
+
+
+def test_mesh_replies_bit_exact_vs_loop_backend(ledger_dir):
+    # same cold window through both backends: byte-identical counts
+    queries = [(50_001, 90_000), (65_000, 120_001), (2, 118_000)]
+    answers = {}
+    for backend in ("mesh", "loop"):
+        with SieveService(
+            _cfg(str(ledger_dir)), _settings(cold_backend=backend)
+        ) as svc:
+            with ServiceClient(svc.addr, timeout_s=30) as c:
+                answers[backend] = [c.count(lo, hi) for lo, hi in queries]
+            st = svc.stats()
+            assert st["cold_backend"] == backend
+            assert st["cold_dispatches"] >= 1
+    assert answers["mesh"] == answers["loop"]
+    assert answers["mesh"] == [o_count(lo, hi) for lo, hi in queries]
+
+
+# --- svc_mesh_fail: typed local fallback (satellite a) -----------------------
+
+
+def test_parse_svc_mesh_fail():
+    d = parse_chaos("svc_mesh_fail:any@s2")[0]
+    assert (d.kind, d.worker, d.seg_id) == ("svc_mesh_fail", ANY_WORKER, 2)
+
+
+def test_svc_mesh_fail_degrades_to_exact_loop(ledger_dir, memsink):
+    with SieveService(_cfg(str(ledger_dir)), _settings()) as svc:
+        # K-th mesh dispatch raises inside the launch span
+        svc.inject_chaos("svc_mesh_fail:any@s1")
+        with ServiceClient(svc.addr, timeout_s=30) as c:
+            assert c.pi(90_000) == o_pi(90_000)   # through the fallback
+            assert c.pi(120_000) == o_pi(120_000)  # mesh again
+        s = svc.stats()
+        assert s["mesh_fallbacks"] == 1
+        assert s["mesh_launches"] >= 1  # the later drain recovered
+        assert s["cold_backend"] == "mesh"  # launch failure isn't fatal
+    ev = [x for x in memsink.records
+          if x["event"] == "service_mesh_fallback"]
+    assert len(ev) == 1
+    assert "svc_mesh_fail" in ev[0]["reason"]
+    for x in ev:
+        validate_record(x)
+
+
+def test_mesh_init_failure_degrades_once(ledger_dir, memsink, monkeypatch):
+    # impossible device ask: init fails, the loop path answers, and the
+    # failure is permanent (one event, no retry storm)
+    monkeypatch.setenv("SIEVE_MESH_COLD_DEVICES", "4096")
+    with SieveService(_cfg(str(ledger_dir)), _settings()) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as c:
+            assert c.pi(90_000) == o_pi(90_000)
+            assert c.pi(120_000) == o_pi(120_000)
+        s = svc.stats()
+        assert s["mesh_launches"] == 0
+        assert s["mesh_fallbacks"] == 1
+        assert s["cold_backend"] == "loop (mesh failed)"
+        assert s["mesh_devices"] == 0
+    ev = [x for x in memsink.records
+          if x["event"] == "service_mesh_fallback"]
+    assert len(ev) == 1 and "init" in ev[0]["reason"]
+
+
+def test_cold_backend_setting_validated():
+    with pytest.raises(ValueError, match="cold_backend"):
+        ServiceSettings(cold_backend="gpu").validate()
+    assert ServiceSettings.from_env().cold_backend == "loop"
+
+
+# --- capacity-scaled cluster assignment (tentpole, cluster half) -------------
+
+
+def test_worker_capacity_env_override(monkeypatch):
+    monkeypatch.setenv("SIEVE_WORKER_CAPACITY", "5")
+    assert _worker_capacity() == 5
+    monkeypatch.delenv("SIEVE_WORKER_CAPACITY")
+    monkeypatch.setenv("SIEVE_CLUSTER_WORKER_BACKEND", "cpu-numpy")
+    assert _worker_capacity() == 1  # scalar class: classic protocol
+
+
+def test_assign_batch_size_evidence_ramp():
+    cfg = SieveConfig(n=10**5, quiet=True)
+    cl = _Cluster(cfg, None, [], MetricsLogger(cfg), None)
+    # unknown worker / scalar class: always 1
+    assert cl.assign_batch_size(7) == 1
+    cl.set_capacity(7, 8)
+    # no attempt samples, no clock alignment: half the ceiling
+    assert cl.assign_batch_size(7) == 4
+    align = cl.clock[7] = ClockAlign()
+    align.sample(0.0, 0.001, 0.001, 0.002)  # rtt ~2 ms
+    for _ in range(8):
+        cl.observe_attempt(0.05)  # fast segments
+    # evidence in, p95*slack*8 well under the deadline floor: full fanout
+    assert cl.assign_batch_size(7) == 8
+    # a straggling worker class halves until the projected silent
+    # window fits the deadline budget again
+    for _ in range(256):
+        cl.observe_attempt(30.0)  # p95*slack = 120 s > 60 s floor
+    assert cl.assign_batch_size(7) < 8
+    # malformed hello never breaks sizing
+    cl.set_capacity(9, "bogus")
+    assert cl.assign_batch_size(9) == 1
+
+
+def test_cluster_capacity_run_exact(monkeypatch):
+    from sieve.metrics import registry
+    from tests.oracles import PI
+
+    monkeypatch.setenv("SIEVE_WORKER_CAPACITY", "4")
+    cfg = SieveConfig(
+        n=10**5, backend="cpu-cluster", workers=2, n_segments=12,
+        twins=True, quiet=True, coordinator_addr="127.0.0.1:0",
+    )
+    res = run_cluster(cfg)
+    assert res.pi == PI[10**5]
+    # the hello handshake carried the class to the coordinator
+    assert registry().gauge("cluster.worker0.capacity").value == 4
+
+
+# --- persist-cold tier-1: restart answers from the store (tentpole) ----------
+
+
+def test_persist_cold_store_restart_hot(tmp_path):
+    dir_a = tmp_path / "a"
+    run_local(_cfg(str(dir_a)))
+    # pre-cold snapshot: B's ledger never sees the cold results, so a
+    # server over B can only answer out of the segment store
+    dir_b = tmp_path / "b"
+    shutil.copytree(dir_a, dir_b)
+    queries = [(50_001, 90_000), (2, 120_000)]
+    settings = _settings(cold_backend="loop", persist_cold=True)
+    with SieveService(_cfg(str(dir_a)), settings) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as c:
+            first = [c.count(lo, hi) for lo, hi in queries]
+        assert svc.stats()["cold_persisted"] >= 1
+    assert first == [o_count(lo, hi) for lo, hi in queries]
+    # the store (boundary words, not just counts) survives; the cold
+    # ledger appends do not — the pre-PR failure mode this tier fixes
+    shutil.copytree(dir_a / "store", dir_b / "store")
+    with SieveService(_cfg(str(dir_b)), settings) as svc:
+        with ServiceClient(svc.addr, timeout_s=30) as c:
+            again = [c.count(lo, hi) for lo, hi in queries]
+        s = svc.stats()
+    assert again == first
+    # restart-hot: every cold chunk came out of tier-1, nothing re-marked
+    assert s["cold_store_hits"] >= 1
+    assert s["cold_computes"] == 0
+
+
+# --- observability surfaces (satellites e/f) ---------------------------------
+
+
+def test_fleet_top_cold_cell():
+    from tools.fleet_top import _cold_cell
+
+    assert _cold_cell(None) == "-"
+    assert _cold_cell({}) == "-"
+    assert _cold_cell({"cold_backend": "loop"}) == "loop"
+    assert _cold_cell(
+        {"cold_backend": "mesh", "mesh_devices": 8, "mesh_fanout": 3}
+    ) == "mesh/8x3"
+    assert _cold_cell(
+        {"cold_backend": "loop (mesh failed)"}
+    ) == "loop (mesh failed)"
+
+
+def test_trace_report_cold_mesh_row():
+    from tools.trace_report import service_report
+
+    spans = [
+        {"name": "rpc.query", "ts": 0.0, "dur": 9_000.0,
+         "args": {"op": "pi", "outcome": "ok", "source": "cold"}},
+        {"name": "query.cold", "ts": 100.0, "dur": 8_000.0, "args": {}},
+        {"name": "query.cold_mesh", "ts": 200.0, "dur": 6_000.0,
+         "args": {"chunks": 5, "devices": 8, "launch": 1}},
+    ]
+    out = "\n".join(service_report(spans))
+    assert "cold mesh" in out
+    assert "1 SPMD launches, 5 chunks, 8 devices" in out
+    # nested inside cold compute: the row must not inflate the split
+    assert "nested in cold compute" in out
+
+
+# --- the smoke gate (satellite c) --------------------------------------------
+
+
+def test_mesh_cold_smoke_subprocess():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "mesh_cold_smoke.py"),
+         "--chunks", "8", "--span", "14"],
+        capture_output=True, text=True, timeout=280,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "MESH_COLD_SMOKE_OK" in proc.stdout
+    assert '"unit": "cold_throughput"' in proc.stdout
+
+
+def test_bench_compare_cold_throughput_gate():
+    from tools.bench_compare import compare
+
+    def _rec(value):
+        return {"service_cold_drain_throughput": {
+            "metric": "service_cold_drain_throughput",
+            "value": value, "unit": "cold_throughput",
+            "vs_baseline": 1.4,
+        }}
+
+    # 50% cold-drain drop: gated
+    lines, regressions = compare(_rec(2_000_000.0), _rec(1_000_000.0), 0.10)
+    assert regressions and "service_cold_drain_throughput" in regressions[0]
+    assert any("cold-drain drop" in line for line in lines)
+    # improvement: clean
+    _, regressions = compare(_rec(2_000_000.0), _rec(2_100_000.0), 0.10)
+    assert not regressions

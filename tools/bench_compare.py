@@ -7,9 +7,11 @@ one JSON metric line per benchmark:
 ``{"metric": ..., "value": ..., "unit": "values/s/chip", ...}``), and
 the last metric re-parsed under ``parsed``. This tool pairs the two
 newest rounds by metric name and prints the delta for each; it exits
-nonzero when any throughput metric (``unit == "values/s/chip"``, or
-``unit == "qps"`` for request throughput — ISSUE 14) regressed by more
-than ``--threshold`` (default 10%), when any latency
+nonzero when any throughput metric (``unit == "values/s/chip"``,
+``unit == "qps"`` for request throughput — ISSUE 14, or
+``unit == "cold_throughput"`` for the mesh cold-drain values/s —
+ISSUE 18) regressed by more than ``--threshold`` (default 10%), when
+any latency
 metric (``unit == "ms_p95"``) *increased* by more than the same
 threshold (lower is better — the service p95 gate, ISSUE 9), when any
 ``unit == "overhead_ratio"`` metric exceeds the ABSOLUTE 1.05 ceiling
@@ -190,6 +192,13 @@ def compare(
             verdict = f"  REGRESSION (> {threshold:.0%} throughput drop)"
             regressions.append(
                 f"{name}: {ov:.4g} qps -> {nv:.4g} qps ({delta:+.1%})"
+            )
+        elif unit == "cold_throughput" and delta < -threshold:
+            # mesh cold-drain throughput (ISSUE 18): values/s through one
+            # SPMD drain slice — higher is better, gate on drops
+            verdict = f"  REGRESSION (> {threshold:.0%} cold-drain drop)"
+            regressions.append(
+                f"{name}: {ov:.4g} -> {nv:.4g} values/s ({delta:+.1%})"
             )
         elif unit == "ms_p95" and delta > threshold:
             # latency: lower is better, gate on increases

@@ -5,7 +5,9 @@ Each poll asks the router for health (which names every shard replica
 address), stats, and the new ``metrics`` wire op, then asks each replica
 for the same three. The rendered table shows, per replica: lane queue
 depths, shed/demotion rates, LRU and cold-cache hit rates, cold dispatch
-rate, the segment-store column (hit ratio / demotions, plus a ``T<n>``
+rate, the cold-backend class column (``mesh/DxF`` = D mesh devices at
+last-drain chunk fanout F, or the loop backend name — ISSUE 18),
+the segment-store column (hit ratio / demotions, plus a ``T<n>``
 torn-entry marker — ISSUE 17), covered_hi, and the worst per-op SLO
 burn — plus a router header
 with request rate, totals-cache hit rate, telemetry merge/gap counters,
@@ -165,6 +167,21 @@ def _store_cell(stats: dict | None) -> str:
     return cell + (f" T{torn}" if torn else "")
 
 
+def _cold_cell(stats: dict | None) -> str:
+    """Cold-plane worker class (ISSUE 18): ``mesh/DxF`` for a mesh
+    replica (D devices, F chunks in the last drain fanout), the plain
+    backend name otherwise, ``-`` for pre-mesh servers."""
+    if not stats:
+        return "-"
+    backend = stats.get("cold_backend")
+    if not backend:
+        return "-"
+    if str(backend).startswith("mesh") and stats.get("mesh_devices"):
+        return (f"mesh/{stats.get('mesh_devices')}"
+                f"x{stats.get('mesh_fanout', 0)}")
+    return str(backend)
+
+
 def _prev_stats(prev: dict | None, shard: int | None,
                 addr: str) -> dict | None:
     if prev is None:
@@ -211,7 +228,8 @@ def render(snap: dict, prev: dict | None = None) -> str:
     lines.append(
         f"  {'replica':<22} {'st':<4} {'hot':>4} {'cold':>4} "
         f"{'shed':>8} {'demote':>8} {'lru':>5} {'ccache':>6} "
-        f"{'colddisp':>9} {'store':>12} {'covered_hi':>11} {'slo burn':>9}"
+        f"{'colddisp':>9} {'cbackend':>10} {'store':>12} "
+        f"{'covered_hi':>11} {'slo burn':>9}"
     )
     for sh in snap["shards"]:
         for rep in sh["replicas"]:
@@ -242,6 +260,7 @@ def render(snap: dict, prev: dict | None = None) -> str:
                 f"{shed_r:>8} {_rate(st, ps, 'demoted', dt):>8} "
                 f"{lru:>5} {ccache:>6} "
                 f"{_rate(st, ps, 'cold_dispatches', dt):>9} "
+                f"{_cold_cell(st):>10} "
                 f"{_store_cell(st):>12} "
                 f"{h.get('covered_hi', 0):>11} {_worst_burn(st):>9}"
             )

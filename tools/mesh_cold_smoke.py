@@ -1,0 +1,205 @@
+"""Mesh cold-plane smoke: one SPMD drain vs K sequential markings
+(ISSUE 18 acceptance; tier-1 via tests/test_mesh_cold.py).
+
+Forces an 8-device virtual CPU mesh (``XLA_FLAGS`` before jax imports),
+then drives the ``MeshWorker`` through the two claims the issue makes:
+
+1. parity — a chunk grid per packing (plain / odds / wheel30, twins on
+   and off) that includes a sub-word sliver (CPU-fallback path) and a
+   deliberately non-power-of-two, non-multiple-of-ndev chunk count (pad
+   rows + masking exercised on every launch). Every ``MeshWorker``
+   result must match the ``CpuNumpyWorker`` reference field-by-field,
+   and every prime count must also match a direct numpy segmented sieve
+   built here from the seed primes — two independent oracles, so a
+   wrong mesh launch cannot hide behind a shared bug.
+2. throughput — the bench half (``service_cold_drain_throughput``):
+   values/s through one drain slice of equal-span cold chunks, mesh
+   (ONE ``shard_map`` launch for the lot) vs loop (the classic
+   ``process_segment``-per-chunk JaxWorker path the service's loop
+   backend runs). Both sides are warmed, parity-asserted against each
+   other, and the launch counter must show exactly one mesh dispatch
+   per drain. The JSON line feeds ``bench.py`` /
+   ``tools/bench_compare.py`` (unit ``cold_throughput``, gated against
+   drops); ``vs_baseline`` is the mesh/loop speedup.
+
+Exit status: 0 on full parity (MESH_COLD_SMOKE_OK), 1 on any violation.
+
+Usage: python tools/mesh_cold_smoke.py [--chunks K] [--span BITS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# the mesh needs its devices BEFORE jax initializes: force the 8-way
+# virtual CPU host unless the caller already forced a device count
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "SIEVE_JAX_PLATFORM", os.environ["JAX_PLATFORMS"].split(",")[0]
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from sieve.backends.cpu_numpy import CpuNumpyWorker  # noqa: E402
+from sieve.backends.mesh_backend import MeshWorker  # noqa: E402
+from sieve.config import SieveConfig  # noqa: E402
+from sieve.seed import seed_primes  # noqa: E402
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}", flush=True)
+    sys.exit(1)
+
+
+def oracle_count(lo: int, hi: int, seeds: np.ndarray) -> int:
+    """Independent prime count for [lo, hi): direct numpy segmented
+    sieve from the seed primes — no sieve/ marking code involved."""
+    is_p = np.ones(hi - lo, dtype=bool)
+    for v in range(lo, min(hi, 2)):
+        is_p[v - lo] = False
+    for p in seeds:
+        p = int(p)
+        if p * p >= hi:
+            break
+        start = max(p * p, ((lo + p - 1) // p) * p)
+        is_p[start - lo:: p] = False
+    return int(is_p.sum())
+
+
+def _cfg(packing: str, twins: bool, n: int) -> SieveConfig:
+    return SieveConfig(
+        n=n, backend="cpu-numpy", packing=packing, twins=twins,
+        n_segments=1, quiet=True,
+    )
+
+
+# parity grid: a sub-word sliver (CPU fallback), word-unaligned spans,
+# and 5 equal-span chunks — not a multiple of 8 devices and not a power
+# of two, so every launch pads rows and must mask them out exactly
+PARITY_SEGS = [
+    (2, 40),
+    (1_000, 9_000),
+    (9_000, 17_192),
+    (60_000, 68_192),
+    (68_192, 76_384),
+]
+
+
+def parity_check() -> None:
+    hi_max = max(hi for _, hi in PARITY_SEGS)
+    seeds = seed_primes(int(hi_max ** 0.5) + 1)
+    for packing in ("plain", "odds", "wheel30"):
+        for twins in (False, True):
+            cfg = _cfg(packing, twins, hi_max)
+            mesh = MeshWorker(cfg)
+            ref = CpuNumpyWorker(cfg)
+            got = mesh.process_segments(PARITY_SEGS, seeds)
+            for i, (lo, hi) in enumerate(PARITY_SEGS):
+                want = ref.process_segment(lo, hi, seeds, i)
+                for f in ("seg_id", "lo", "hi", "count", "twin_count",
+                          "first_word", "last_word", "nbits"):
+                    g, w = getattr(got[i], f), getattr(want, f)
+                    if g != w:
+                        fail(
+                            f"parity {packing}/twins={twins} "
+                            f"[{lo},{hi}) field {f}: mesh={g} cpu={w}"
+                        )
+                oc = oracle_count(lo, hi, seeds)
+                if got[i].count != oc:
+                    fail(
+                        f"oracle {packing}/twins={twins} [{lo},{hi}): "
+                        f"mesh count={got[i].count} oracle={oc}"
+                    )
+            if mesh.launches < 1:
+                fail(f"parity {packing}: no mesh launches recorded")
+            mesh.close()
+            ref.close()
+    print("parity: plain/odds/wheel30 x twins on/off exact "
+          "(mesh vs cpu-numpy vs direct oracle)", flush=True)
+
+
+def throughput(chunks: int, span_bits: int) -> dict:
+    span = 1 << span_bits
+    lo0 = 10_000_000
+    segs = [(lo0 + i * span, lo0 + (i + 1) * span) for i in range(chunks)]
+    hi_max = segs[-1][1]
+    seeds = seed_primes(int(hi_max ** 0.5) + 1)
+    cfg = _cfg("odds", False, hi_max)
+
+    mesh = MeshWorker(cfg)
+    mesh.process_segments(segs, seeds)  # warm: compile + prepare cache
+    launches0 = mesh.launches
+    t0 = time.perf_counter()
+    mesh_res = mesh.process_segments(segs, seeds)
+    mesh_s = time.perf_counter() - t0
+    drain_launches = mesh.launches - launches0
+    if drain_launches != 1:
+        fail(
+            f"one drain of {chunks} equal-span chunks took "
+            f"{drain_launches} SPMD launches (want exactly 1)"
+        )
+
+    # the loop alternative the service's --cold-backend loop runs: the
+    # same jax kernel, one process_segment launch per chunk
+    from sieve.backends.jax_backend import JaxWorker
+
+    loop = JaxWorker(cfg)
+    for i, (lo, hi) in enumerate(segs):  # warm
+        loop.process_segment(lo, hi, seeds, i)
+    t0 = time.perf_counter()
+    loop_res = [
+        loop.process_segment(lo, hi, seeds, i)
+        for i, (lo, hi) in enumerate(segs)
+    ]
+    loop_s = time.perf_counter() - t0
+
+    for m, l_ in zip(mesh_res, loop_res):
+        if (m.count, m.first_word, m.last_word) != (
+            l_.count, l_.first_word, l_.last_word
+        ):
+            fail(f"mesh vs loop drift at [{m.lo},{m.hi})")
+    values = chunks * span
+    out = {
+        "metric": "service_cold_drain_throughput",
+        "value": round(values / mesh_s, 1),
+        "unit": "cold_throughput",
+        "vs_baseline": round(loop_s / mesh_s, 3),
+        "loop_values_per_sec": round(values / loop_s, 1),
+        "chunks": chunks,
+        "devices": mesh.devices,
+        "spmd_launches": drain_launches,
+    }
+    mesh.close()
+    loop.close()
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--chunks", type=int, default=16,
+                   help="cold chunks per drain slice (default 16)")
+    p.add_argument("--span", type=int, default=16,
+                   help="log2 chunk span (default 16 -> 65536 values)")
+    args = p.parse_args(argv)
+    parity_check()
+    line = throughput(args.chunks, args.span)
+    print(json.dumps(line), flush=True)
+    print("MESH_COLD_SMOKE_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -271,6 +271,23 @@ def service_report(spans: list[dict]) -> list[str]:
             f"{100 * over / total if total else 0:>6.1f}%"
             f"  ({len(batches)} dispatches, {chunks} chunks)"
         )
+    # mesh cold plane (ISSUE 18): query.cold_mesh spans nest inside
+    # query.cold, so this row is informational (NOT added to accounted
+    # — that would double-count) — it shows how much of the cold compute
+    # ran as one-launch SPMD rounds and at what chunk fanout
+    mesh = [e for e in spans if e["name"] == "query.cold_mesh"]
+    if mesh:
+        mesh_t = sum(e["dur"] for e in mesh)
+        chunks = sum((e.get("args") or {}).get("chunks", 0) for e in mesh)
+        devices = max(
+            (e.get("args") or {}).get("devices", 0) for e in mesh
+        )
+        lines.append(
+            f"    {'cold mesh':<18} {mesh_t / 1e3:>10.3f} ms "
+            f"{100 * mesh_t / total if total else 0:>6.1f}%"
+            f"  ({len(mesh)} SPMD launches, {chunks} chunks, "
+            f"{devices} devices; nested in cold compute)"
+        )
     other = max(0.0, total - accounted)
     lines.append(
         f"    {'index/other':<18} {other / 1e3:>10.3f} ms "
