@@ -1259,6 +1259,119 @@ def service_lock_debug_overhead_metric() -> None:
     )
 
 
+def service_observer_overhead_metric() -> None:
+    """Capacity-observatory overhead (ISSUE 19): the line-8 mixed
+    workload and methodology — interleaved off/on passes, fresh service
+    per pass, untimed warmup, client-side timing, min-across-reps p95 —
+    with the whole observatory as the variable: ON arms always-on
+    exemplar tail sampling (span ring + completion-time sampler + the
+    rolling exemplar file under a real debug dir) AND a live
+    :class:`FleetObserver` scraping the service's health+stats at 1 Hz
+    into an on-disk snapshot ring; OFF disables both. Both passes run
+    with the flight recorder armed (its cost is line 9's ratio — this
+    line prices only the NEW machinery on top). Nothing alarms during
+    the workload (warmup exceeds a pass's scrape count), so the ratio
+    is exactly the steady-state tax every observed production fleet
+    pays. Every reply asserted exact."""
+    import tempfile
+
+    import numpy as np
+
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+    from sieve.seed import seed_primes
+    from sieve.service import ServiceClient, ServiceSettings, SieveService
+    from sieve.service.observe import FleetObserver, ObserverSettings
+
+    n = 2_000_000
+    chunk = 1 << 18
+    reps = 25
+    oracle = seed_primes(n + 9 * chunk)
+
+    def o_pi(x: int) -> int:
+        return int(np.searchsorted(oracle, x, side="right"))
+
+    def workload(cli: ServiceClient, timings: list[float]) -> None:
+        def timed(fn, *a):
+            t0 = time.perf_counter()
+            out = fn(*a)
+            timings.append((time.perf_counter() - t0) * 1e3)
+            return out
+
+        for i in range(150):  # hot: prefix counts
+            x = (7919 * (i + 1)) % n
+            assert timed(cli.pi, x) == o_pi(x), f"pi({x}) parity failure"
+        for i in range(50):   # hot: windowed counts (materialize tier)
+            lo = (104_729 * (i + 1)) % (n - 60_000)
+            want = o_pi(lo + 50_000 - 1) - o_pi(lo - 1)
+            assert timed(cli.count, lo, lo + 50_000) == want, \
+                f"count({lo}) parity failure"
+        for i in range(8):    # cold: one fresh chunk each, batched
+            x = n + (i + 1) * chunk - 1
+            assert timed(cli.pi, x) == o_pi(x), f"cold pi({x}) parity"
+
+    with tempfile.TemporaryDirectory(prefix="sieve_bench_obs_ck") as ck, \
+            tempfile.TemporaryDirectory(prefix="sieve_bench_obs_dbg") as dbg:
+        cfg = SieveConfig(
+            n=n, backend="cpu-numpy", packing="odds", n_segments=8,
+            checkpoint_dir=ck, quiet=True,
+        )
+        run_local(cfg)
+
+        def run_pass(observed: bool) -> list[float]:
+            sub = os.path.join(dbg, "on" if observed else "off")
+            settings = ServiceSettings(
+                workers=4, queue_limit=64, cold_chunk=chunk,
+                refresh_s=0.0, exemplars=observed, debug_dir=sub,
+            )
+            with SieveService(cfg, settings) as svc, \
+                    ServiceClient(svc.addr, timeout_s=60) as cli:
+                obs = None
+                if observed:
+                    obs = FleetObserver(svc.addr, ObserverSettings(
+                        scrape_s=1.0, observe_dir=sub, debug_pull=False,
+                        quiet=True,
+                    ))
+                    obs.start()
+                try:
+                    timings: list[float] = []
+                    for i in range(30):  # untimed warmup
+                        cli.pi((101 * (i + 1)) % n)
+                    workload(cli, timings)
+                finally:
+                    if obs is not None:
+                        obs.stop()
+            return timings
+
+        p95s_off: list[float] = []
+        p95s_on: list[float] = []
+        n_reqs = 0
+        for _ in range(reps):
+            off = run_pass(observed=False)
+            on = run_pass(observed=True)
+            p95s_off.append(_pctile(off, 0.95))
+            p95s_on.append(_pctile(on, 0.95))
+            n_reqs = len(on)
+    p95_off = min(p95s_off)
+    p95_on = min(p95s_on)
+    ratio = p95_on / p95_off if p95_off else float("inf")
+    budget = 1.05
+    print(
+        json.dumps(
+            {
+                "metric": "service_observer_overhead_ratio",
+                "value": round(ratio, 4),
+                "unit": "overhead_ratio",
+                "vs_baseline": round(budget / ratio, 3) if ratio else None,
+                "p95_unobserved_ms": round(p95_off, 3),
+                "p95_observed_ms": round(p95_on, 3),
+                "n": n_reqs,
+                "reps": reps,
+            }
+        )
+    )
+
+
 def service_cold_drain_throughput_metric() -> None:
     """Mesh cold-plane drain throughput (ISSUE 18): values/s through one
     drain slice of equal-span cold chunks on the mesh backend (ONE
@@ -1309,6 +1422,7 @@ def main() -> int:
     service_trace_overhead_metric()
     service_recorder_overhead_metric()
     service_lock_debug_overhead_metric()
+    service_observer_overhead_metric()
     service_cold_drain_throughput_metric()
     return 0
 

@@ -52,6 +52,10 @@ CANONICAL_LOCK_ORDER: tuple[str, ...] = (
     #    everything below while held only in stop/teardown paths)
     "_Cluster.lock",
     "_Cluster.tele_lock",
+    # -- fleet observer (ISSUE 19): guards trend/EWMA state only; by
+    #    contract never held across a pool RPC or ring I/O, so it sits
+    #    above the client tier without real edges into it
+    "FleetObserver._lock",
     "ClientPool._lock",
     "ReplicaSet._lock",
     "_Replica.lock",
@@ -87,6 +91,14 @@ CANONICAL_LOCK_ORDER: tuple[str, ...] = (
     #    the store's own compactor thread; holds only leaf locks below
     #    (ChaosSchedule draw, metrics emits happen outside _lock)
     "TieredSegmentStore._lock",
+    # -- capacity observatory sinks (ISSUE 19): the sampler's decision
+    #    window/ring lock and its writer-queue condition are never
+    #    nested with each other (keep() releases _lock before the
+    #    enqueue; the writer thread releases the condition before
+    #    touching the file); the snapshot ring holds only its own I/O
+    "ExemplarSampler._lock",
+    "ExemplarSampler._io_cond",
+    "SnapshotRing._lock",
     # -- client wire-event logger init (ISSUE 16): taken during client
     #    construction (possibly under _Replica.lock) and released
     #    before the metrics leaf locks below are touched

@@ -142,6 +142,19 @@ mesh-launch counter, like the batcher draws batch dispatches):
                               the loop path, never to a wrong answer or
                               a crash.
 
+Observer plane (ISSUE 19; drawn by the fleet observer on its own
+*scrape* counter, like the batcher draws batch dispatches):
+
+* ``svc_scrape_gap:any@sK``   the K-th observer scrape of a target
+                              endpoint raises mid-poll: the observer
+                              records a counted gap row (a
+                              ``observer_scrape_gap`` event) and moves
+                              on — it must never fabricate a sample for
+                              the missed endpoint, and the anomaly
+                              engine must not alarm on the gap itself
+                              (gap-aware windows re-arm only after a
+                              fresh real sample).
+
 Flight recorder (ISSUE 13):
 
 * ``svc_crash:any@sK``        request K's worker thread raises uncaught
@@ -195,6 +208,7 @@ KINDS = (
     "svc_slow_frame",
     "store_torn_write",
     "svc_mesh_fail",
+    "svc_scrape_gap",
 )
 # kinds handled by the query service (sieve/service/); the cluster plane
 # ignores these and vice versa. Request-scoped kinds key on the request
@@ -234,6 +248,10 @@ SERVICE_REQUEST_KINDS = (
 # directive's worker field names a shard index there, so shard servers
 # never consume these even when one --chaos string drives both tiers
 ROUTER_REQUEST_KINDS = ("svc_shard_down",)
+# drawn by the fleet observer (ISSUE 19) on its own scrape counter; the
+# worker field names the target's index in the observer's target list,
+# so neither serving tier ever consumes these
+OBSERVER_KINDS = ("svc_scrape_gap",)
 # kinds whose param is a LANE NAME ("hot"/"cold"), not seconds
 LANE_PARAM_KINDS = ("svc_flood",)
 _LANES = ("hot", "cold")
@@ -262,6 +280,7 @@ DEFAULT_PARAM: dict[str, float | str | None] = {
     "svc_slow_frame": 1.0,
     "store_torn_write": None,
     "svc_mesh_fail": None,
+    "svc_scrape_gap": None,
 }
 
 

@@ -16,6 +16,11 @@ changes:
     python -m sieve route --addr 127.0.0.1:7733 \\
         --shard 2:5e8=127.0.0.1:7723,127.0.0.1:7724 \\
         --shard 5e8:1e9=127.0.0.1:7725
+
+The ``observe`` subcommand runs the capacity observatory against such a
+fabric (sieve/service/observe.py) — fleet trend ring + anomaly engine:
+
+    python -m sieve observe --router 127.0.0.1:7733 --observe-dir obs
 """
 
 from __future__ import annotations
@@ -23,6 +28,8 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import threading
+import time
 
 from sieve import env
 from sieve.config import BACKENDS, PACKINGS, SieveConfig
@@ -154,6 +161,12 @@ def main(argv: list[str] | None = None) -> int:
     if argv and argv[0] == "route":
         try:
             return _route(argv[1:])
+        except (ValueError, RuntimeError, ImportError) as e:
+            print(f"sieve: error: {e}", file=sys.stderr)
+            return 2
+    if argv and argv[0] == "observe":
+        try:
+            return _observe(argv[1:])
         except (ValueError, RuntimeError, ImportError) as e:
             print(f"sieve: error: {e}", file=sys.stderr)
             return 2
@@ -603,7 +616,7 @@ def _route(argv: list[str]) -> int:
         overrides["quiet"] = True
     if args.debug_dir is not None:
         overrides["debug_dir"] = args.debug_dir
-    settings = RouterSettings(**overrides)
+    settings = RouterSettings.from_env(**overrides)
 
     file_sink = None
     if args.metrics_file:
@@ -641,6 +654,98 @@ def _route(argv: list[str]) -> int:
         if args.trace_file:
             trace.disable()
             trace.save(args.trace_file)
+        if file_sink is not None:
+            metrics.remove_sink(file_sink)
+            file_sink.close()
+    return 0
+
+
+def build_observe_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sieve observe",
+        description="Capacity observatory daemon: scrape a router and "
+                    "every advertised shard replica on a cadence, persist "
+                    "a CRC'd ring of downsampled fleet snapshots, and run "
+                    "the EWMA anomaly engine (fleet_anomaly / "
+                    "scaling_advice events; sieve/service/observe.py)",
+    )
+    p.add_argument("--router", required=True, metavar="ADDR",
+                   help="router host:port to scrape (shard replicas are "
+                        "discovered from its health reply)")
+    p.add_argument("--observe-dir", default=None,
+                   help="directory for the snapshot ring (fleet_ring.bin) "
+                        "and anomaly-triggered fleet debug bundles; "
+                        "omitted = in-memory trends only")
+    p.add_argument("--scrape-s", type=float, default=None,
+                   help="seconds between scrape cycles (default 1.0)")
+    p.add_argument("--timeout-s", type=float, default=None,
+                   help="per-endpoint RPC timeout (default 5.0)")
+    p.add_argument("--scrapes", type=int, default=0, metavar="N",
+                   help="stop after N scrape cycles (0 = run until "
+                        "SIGTERM; N > 0 runs the cycles inline and exits "
+                        "— the smoke-test mode)")
+    p.add_argument("--chaos", default=None,
+                   help="observer fault schedule, e.g. "
+                        "'svc_scrape_gap:any@s3' (segment number = the "
+                        "observer's scrape counter; worker = target index "
+                        "in discovery order, any = every target)")
+    p.add_argument("--metrics-file", default=None, dest="metrics_file")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress per-scrape stderr event lines")
+    return p
+
+
+def _observe(argv: list[str]) -> int:
+    args = build_observe_parser().parse_args(argv)
+
+    from sieve import metrics
+    from sieve.chaos import ChaosSchedule, parse_chaos
+    from sieve.service.observe import FleetObserver, ObserverSettings
+
+    overrides: dict = {}
+    if args.scrape_s is not None:
+        overrides["scrape_s"] = args.scrape_s
+    if args.timeout_s is not None:
+        overrides["timeout_s"] = args.timeout_s
+    if args.observe_dir is not None:
+        overrides["observe_dir"] = args.observe_dir
+    if args.quiet:
+        overrides["quiet"] = True
+    settings = ObserverSettings.from_env(**overrides)
+    chaos = ChaosSchedule(parse_chaos(args.chaos or ""))
+
+    file_sink = None
+    if args.metrics_file:
+        file_sink = metrics.FileSink(args.metrics_file)
+        metrics.add_sink(file_sink)
+    obs = FleetObserver(args.router, settings, chaos=chaos)
+    try:
+        print(json.dumps({
+            "event": "observing",
+            "router": args.router,
+            "observe_dir": settings.observe_dir,
+            "scrape_s": settings.scrape_s,
+        }), flush=True)
+        if args.scrapes > 0:
+            # bounded inline mode: deterministic for smoke tests and cron
+            for _ in range(args.scrapes):
+                obs.scrape_once()
+                if _ < args.scrapes - 1:
+                    time.sleep(settings.scrape_s)
+        else:
+            import signal
+
+            stop = threading.Event()
+            signal.signal(signal.SIGTERM, lambda *_: stop.set())
+            signal.signal(signal.SIGINT, lambda *_: stop.set())
+            obs.start()
+            stop.wait()
+        print(json.dumps({"event": "observed", **obs.stats()}),
+              flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        obs.stop()
         if file_sink is not None:
             metrics.remove_sink(file_sink)
             file_sink.close()

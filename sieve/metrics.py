@@ -163,6 +163,24 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     # answers stay exact either way.
     "service_mesh_dispatch": {"chunks", "devices", "launch", "ms"},
     "service_mesh_fallback": {"reason", "chunks"},
+    # capacity observatory (ISSUE 19): service_exemplar_kept is one
+    # tail-sampled span tree retained at request completion ("reason" is
+    # the retention rule: error/flagged/slow/baseline); observer_scrape_gap
+    # is one failed observer poll (chaos or a genuinely down endpoint) —
+    # counted, never fabricated into a sample; fleet_anomaly is an
+    # edge-triggered robust z-score breach with its evidence row (and the
+    # fleet debug bundle it pulled); scaling_advice a split/merge/
+    # add-replica advisory derived from the same trend windows.
+    "service_exemplar_kept": {"role", "ctx", "op", "outcome", "reason",
+                              "ms", "spans"},
+    "observer_scrape_gap": {"addr", "scrape", "gap"},
+    "fleet_anomaly": {"addr", "signal", "value", "mean", "dev", "z",
+                      "scrape", "bundle"},
+    "scaling_advice": {"advice", "shard", "qps", "shed_rate", "share",
+                       "scrape"},
+    # a scrape cycle that raised past the per-endpoint nets: counted so
+    # a silently wedged observer is visible, never fatal to the daemon
+    "observer_error": {"error"},
 }
 
 

@@ -102,6 +102,25 @@ read-only on the ledger-follower cadence. The ``store_torn_write``
 chaos kind garbles a record mid-append: CRC readers skip it, count a
 ``store_torn_entry`` event, and re-materialize — never a crash, never
 a wrong answer.
+
+Capacity observatory (ISSUE 19): tracing becomes always-on tail
+sampling — every server and router keeps a cheap exemplar span ring
+and an :class:`~sieve.service.exemplar.ExemplarSampler` decides at
+request *completion* which span trees to keep (100% of typed-error /
+shed / demoted requests, latency outliers past the sampler's own
+rolling p95 × slack, and a deterministic 1-in-N healthy baseline),
+persisting them to a rolling ``exemplars.jsonl`` under ``--debug-dir``;
+the ``exemplars`` wire op serves the in-memory ring inline, and the
+router pulls the downstream exemplars of a kept route so one file
+explains the whole path. On top, :mod:`sieve.service.observe` runs the
+fleet trend plane: ``python -m sieve observe`` scrapes router + every
+advertised replica through one :class:`ClientPool`, persists a CRC'd
+:class:`~sieve.service.observe.SnapshotRing` of downsampled fleet
+snapshots, and an EWMA + robust z-score engine emits edge-triggered
+``fleet_anomaly`` events (each firing a fleet-wide flight-recorder
+pull) and ``scaling_advice`` rows. The ``svc_scrape_gap`` chaos kind
+drills a failed scrape: a counted gap, never a fabricated sample,
+never a false alarm.
 """
 
 from sieve.service.client import (
@@ -111,7 +130,14 @@ from sieve.service.client import (
     ServiceClient,
     ServiceError,
 )
+from sieve.service.exemplar import ExemplarSampler, load_exemplars
 from sieve.service.index import QueryCtx, SieveIndex
+from sieve.service.observe import (
+    FleetObserver,
+    ObserverSettings,
+    SnapshotRing,
+    read_ring,
+)
 from sieve.service.router import RouterSettings, ShardUnavailable, SieveRouter
 from sieve.service.server import (
     BadRequest,
@@ -135,7 +161,10 @@ __all__ = [
     "DeadlineExceeded",
     "Degraded",
     "Draining",
+    "ExemplarSampler",
+    "FleetObserver",
     "LedgerFollower",
+    "ObserverSettings",
     "Overloaded",
     "QueryCtx",
     "ReplicaSet",
@@ -149,6 +178,9 @@ __all__ = [
     "SieveIndex",
     "SieveRouter",
     "SieveService",
+    "SnapshotRing",
     "StoreSettings",
     "TieredSegmentStore",
+    "load_exemplars",
+    "read_ring",
 ]

@@ -397,6 +397,21 @@ class ServiceClient:
         endpoint runs with the recorder disabled."""
         return self._call({"type": "debug"})["bundle"]
 
+    def exemplars(self, ctx: str | None = None,
+                  n: int | None = None) -> list[dict]:
+        """Kept tail-sampled exemplars (ISSUE 19), newest last.
+
+        Served inline from the endpoint's in-memory ring; ``ctx`` is a
+        trace-context prefix filter (how the router pulls the downstream
+        exemplars of one slow route), ``n`` caps the count. Empty when
+        the endpoint runs with exemplar sampling disabled."""
+        msg: dict = {"type": "exemplars"}
+        if ctx is not None:
+            msg["ctx"] = ctx
+        if n is not None:
+            msg["n"] = n
+        return self._call(msg)["exemplars"]
+
     def inject_chaos(self, spec: str) -> dict:
         return self._call({"type": "chaos", "spec": spec})
 
@@ -414,8 +429,8 @@ class ClientPool:
         self._clients: dict[str, ServiceClient] = {}
         self._ever: set[str] = set()
         self._lock = named_lock("ClientPool._lock")
-        self.connects = 0
-        self.reconnects = 0
+        self.connects = 0    # guard: _lock
+        self.reconnects = 0  # guard: _lock
 
     def get(self, addr: str) -> ServiceClient:
         """Cached client for ``addr``; (re)connects only when there is
@@ -885,6 +900,39 @@ class ReplicaSet:
             except (ConnectionError, OSError, CallTimeout):
                 self._mark_down(rep)
         return replies
+
+    def exemplars(self, ctx: str | None = None) -> list[dict]:
+        """Kept exemplars from EVERY reachable replica (ISSUE 19).
+
+        Every replica is visited, not first-reachable — a routed
+        request's downstream query ran on exactly one of them, and the
+        caller does not know which. Each record is tagged with the
+        replica address it came from; unreachable replicas are skipped
+        (a down replica must not fail the pull that is trying to
+        explain why a route was slow). A failed pull only drops the
+        cached connection — it never marks the replica down: the
+        observability plane must not mutate routing state, or a
+        monitoring sweep would pre-empt (and hide) the query path's own
+        failover accounting."""
+        out: list[dict] = []
+        msg: dict = {"type": "exemplars"}
+        if ctx is not None:
+            msg["ctx"] = ctx
+        for rep in self._replicas:
+            try:
+                with rep.lock:
+                    if rep.client is None:
+                        rep.client = self._connect(rep.addr)
+                    reply = rep.client._call(dict(msg))
+                for rec in reply.get("exemplars") or []:
+                    rec["addr"] = rep.addr
+                    out.append(rec)
+            except (ConnectionError, OSError, CallTimeout):
+                with rep.lock:
+                    if rep.client is not None:
+                        rep.client.close()
+                        rep.client = None
+        return out
 
     def _value(self, reply: dict):
         if reply.get("ok"):

@@ -90,6 +90,7 @@ from sieve.rpc import (
     recv_msg,
 )
 from sieve.service.client import CallTimeout, ReplicaSet, ServiceError
+from sieve.service.exemplar import EXEMPLAR_SPAN_RING, ExemplarSampler
 from sieve.service.server import BadRequest, DeadlineExceeded, Draining
 from sieve.service.shards import ShardMap
 from sieve import trace
@@ -160,6 +161,18 @@ class RouterSettings:
     # hello answers ``wire: 1`` upstream AND the downstream shard legs
     # skip negotiation (the mixed-fleet simulation knob)
     wire_v2: bool = True
+    # tail-sampled exemplars (ISSUE 19): same sampler as the service,
+    # applied at route completion. A kept route also pulls the touched
+    # shards' exemplars for its trace context (the ``exemplars`` wire
+    # op), so a slow route and its downstream query land in one record.
+    # Env spellings are shared with the service (SIEVE_SVC_EXEMPLAR_*).
+    exemplars: bool = True
+    exemplar_slack: float = 2.0
+    exemplar_baseline: int = 100
+    exemplar_window: int = 256
+    exemplar_warmup: int = 30
+    exemplar_ring: int = 256
+    exemplar_file_bytes: int = 4 << 20
 
     def validate(self) -> "RouterSettings":
         for name in ("default_deadline_s", "timeout_s", "probe_timeout_s"):
@@ -185,7 +198,60 @@ class RouterSettings:
                 f"router settings: rounds={self.rounds!r} must be a "
                 "positive integer"
             )
+        for name in ("exemplar_baseline", "exemplar_window",
+                     "exemplar_ring", "exemplar_file_bytes"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                raise ValueError(
+                    f"router settings: {name}={v!r} must be a positive "
+                    "integer"
+                )
+        if (not isinstance(self.exemplar_warmup, int)
+                or isinstance(self.exemplar_warmup, bool)
+                or self.exemplar_warmup < 0):
+            raise ValueError(
+                f"router settings: exemplar_warmup="
+                f"{self.exemplar_warmup!r} must be a non-negative integer"
+            )
+        if (not isinstance(self.exemplar_slack, (int, float))
+                or isinstance(self.exemplar_slack, bool)
+                or self.exemplar_slack < 1.0
+                or not math.isfinite(self.exemplar_slack)):
+            raise ValueError(
+                f"router settings: exemplar_slack="
+                f"{self.exemplar_slack!r} must be a number >= 1"
+            )
         return self
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "RouterSettings":
+        """Defaults + the shared SIEVE_SVC_EXEMPLAR_* env spellings
+        (the router has far fewer env knobs than the service; explicit
+        overrides — the CLI flags — always win)."""
+        from sieve import env
+
+        s = cls(
+            exemplars=env.env_flag("SIEVE_SVC_EXEMPLARS", True),
+            exemplar_slack=env.env_float(
+                "SIEVE_SVC_EXEMPLAR_SLACK", cls.exemplar_slack
+            ),
+            exemplar_baseline=env.env_int(
+                "SIEVE_SVC_EXEMPLAR_BASELINE", cls.exemplar_baseline
+            ),
+            exemplar_window=env.env_int(
+                "SIEVE_SVC_EXEMPLAR_WINDOW", cls.exemplar_window
+            ),
+            exemplar_warmup=env.env_int(
+                "SIEVE_SVC_EXEMPLAR_WARMUP", cls.exemplar_warmup
+            ),
+            exemplar_ring=env.env_int(
+                "SIEVE_SVC_EXEMPLAR_RING", cls.exemplar_ring
+            ),
+            exemplar_file_bytes=env.env_int(
+                "SIEVE_SVC_EXEMPLAR_FILE_BYTES", cls.exemplar_file_bytes
+            ),
+        )
+        return dataclasses.replace(s, **overrides)
 
 
 class _RouteCtx:
@@ -226,6 +292,10 @@ _ROUTER_STATS = (
     "batch_requests",
     "batch_members",
     "batch_rpcs",
+    # tail-sampled exemplars (ISSUE 19)
+    "exemplars_seen",
+    "exemplars_kept",
+    "exemplar_pulls",
 )
 
 # synthetic pid base for per-shard-replica tracks in the merged trace
@@ -320,6 +390,22 @@ class SieveRouter:
                 logger=self.metrics,
                 cooldown_s=s.debug_cooldown_s,
             )
+        # tail-sampled exemplars (ISSUE 19): route-completion retention;
+        # a kept route embeds the touched shards' downstream exemplars
+        # for its trace context under "downstream"
+        self.exemplar: ExemplarSampler | None = None
+        if s.exemplars:
+            self.exemplar = ExemplarSampler(
+                "router",
+                slack=s.exemplar_slack,
+                baseline=s.exemplar_baseline,
+                window=s.exemplar_window,
+                warmup=s.exemplar_warmup,
+                ring=s.exemplar_ring,
+                file_bytes=s.exemplar_file_bytes,
+                debug_dir=s.debug_dir,
+                logger=self.metrics,
+            )
 
     # --- lifecycle -------------------------------------------------------
 
@@ -342,6 +428,10 @@ class SieveRouter:
         if self.recorder is not None:
             self.history.start()
             self.recorder.install()
+        if self.exemplar is not None:
+            # arm the process tracer's exemplar span ring (independent
+            # of full event capture — ``trace.enable`` stays off)
+            trace.get_tracer().exemplar_enable(EXEMPLAR_SPAN_RING)
         return self
 
     def drain(self) -> None:
@@ -410,6 +500,8 @@ class SieveRouter:
                     pass
         for rs in self.sets:
             rs.close()
+        if self.exemplar is not None:
+            self.exemplar.close()
         if self.recorder is not None:
             self.recorder.uninstall()
             self.history.stop()
@@ -1233,6 +1325,20 @@ class SieveRouter:
                            if self.recorder is not None else None),
             })
             return
+        if mtype == "exemplars":
+            # kept-exemplar pull (ISSUE 19): the router's own ring —
+            # each record already embeds its downstream shard exemplars
+            ctx_f = msg.get("ctx")
+            n_f = msg.get("n")
+            self._reply(conn, send_lock, {
+                "type": "exemplars", "id": rid, "ok": True,
+                "role": "router",
+                "exemplars": (self.exemplar.tail(
+                    n=n_f if isinstance(n_f, int) else None,
+                    ctx_prefix=ctx_f if isinstance(ctx_f, str) else None,
+                ) if self.exemplar is not None else []),
+            })
+            return
         if mtype == "shutdown":
             self._reply(conn, send_lock,
                         {"type": "reply", "id": rid, "ok": True,
@@ -1427,6 +1533,32 @@ class SieveRouter:
             extra, cols = bo.wire()
             reply.update(extra)
         self._reply(conn, send_lock, reply, cols=cols)
+        # tail-sampled exemplar (ISSUE 19), AFTER the reply: a kept
+        # route pulls the touched shards' exemplars for this trace
+        # context (the ``exemplars`` wire op), so the downstream pull's
+        # RPC cost never rides on the client's latency
+        if self.exemplar is not None:
+            self._bump("exemplars_seen")
+            reason = self.exemplar.decide(outcome, reply["elapsed_ms"])
+            if reason is not None:
+                self._bump("exemplars_kept")
+                downstream: list[dict] = []
+                for si in sorted(rctx.shards):
+                    if 0 <= si < len(self.sets):
+                        self._bump("exemplar_pulls")
+                        for rec in self.sets[si].exemplars(ctx=rctx.ctx):
+                            rec["shard"] = si
+                            downstream.append(rec)
+                self.exemplar.keep({
+                    "ctx": rctx.ctx,
+                    "op": op,
+                    "outcome": outcome,
+                    "ms": reply["elapsed_ms"],
+                    "shards": sorted(rctx.shards),
+                    "reason": reason,
+                    "spans": trace.exemplar_collect(rctx.ctx),
+                    "downstream": downstream,
+                })
 
 
 def _req_int(msg: dict, field: str) -> int:

@@ -122,6 +122,7 @@ from sieve.checkpoint import (
 from sieve.bitset import get_layout
 from sieve.enumerate import MAX_HI, primes_in_range
 from sieve.metrics import MetricsHistory, MetricsLogger, registry, sample_interval_s
+from sieve.service.exemplar import EXEMPLAR_SPAN_RING, ExemplarSampler
 from sieve.service.store import TIER_BOUNDARY, StoreSettings, TieredSegmentStore
 from sieve.worker import SegmentResult
 from sieve.rpc import (
@@ -334,6 +335,22 @@ class ServiceSettings:
     # single-worker path. Mesh init or launch failure falls back to the
     # loop worker — typed (event + counter), never a wrong answer.
     cold_backend: str = "loop"
+    # tail-sampled exemplar tracing (ISSUE 19): when on, every request's
+    # ctx-carrying spans land in the tracer's exemplar ring, and at
+    # completion a sampler decides retention — keep the span tree if the
+    # request ended typed-error/shed/degraded/demoted, or its latency
+    # exceeded the self-tracked rolling p95 x exemplar_slack (armed only
+    # after exemplar_warmup observations), plus a deterministic
+    # 1-in-exemplar_baseline healthy baseline. Kept trees go to a
+    # bounded in-memory ring (served by the ``exemplars`` wire op) and,
+    # under debug_dir, a size-capped rolling exemplars.jsonl.
+    exemplars: bool = True
+    exemplar_slack: float = 2.0
+    exemplar_baseline: int = 100
+    exemplar_window: int = 256
+    exemplar_warmup: int = 30
+    exemplar_ring: int = 256
+    exemplar_file_bytes: int = 4 << 20
 
     def validate(self) -> "ServiceSettings":
         """Typed startup validation: every rejection names the setting
@@ -343,7 +360,9 @@ class ServiceSettings:
         for name in ("queue_limit", "workers", "batch_max_chunks",
                      "lru_segments", "cold_chunk", "cold_cache_entries",
                      "max_primes", "max_pair_span", "breaker_fails",
-                     "batch_queries", "write_queue_bytes"):
+                     "batch_queries", "write_queue_bytes",
+                     "exemplar_baseline", "exemplar_window",
+                     "exemplar_ring", "exemplar_file_bytes"):
             v = getattr(self, name)
             if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
                 raise ValueError(
@@ -414,6 +433,22 @@ class ServiceSettings:
             raise ValueError(
                 f"service settings: telemetry_batch={self.telemetry_batch!r} "
                 "must be a positive integer"
+            )
+        if (not isinstance(self.exemplar_warmup, int)
+                or isinstance(self.exemplar_warmup, bool)
+                or self.exemplar_warmup < 0):
+            raise ValueError(
+                f"service settings: exemplar_warmup="
+                f"{self.exemplar_warmup!r} must be a non-negative integer"
+            )
+        if (not isinstance(self.exemplar_slack, (int, float))
+                or isinstance(self.exemplar_slack, bool)
+                or self.exemplar_slack < 1.0
+                or not math.isfinite(self.exemplar_slack)):
+            raise ValueError(
+                f"service settings: exemplar_slack="
+                f"{self.exemplar_slack!r} must be a number >= 1 (the "
+                "rolling-p95 multiplier)"
             )
         if self.debug_dir is not None and (
             not isinstance(self.debug_dir, str) or not self.debug_dir
@@ -506,6 +541,25 @@ class ServiceSettings:
             store=_env_bool("SIEVE_STORE", "1"),
             cold_backend=(
                 env.env_str("SIEVE_SVC_COLD_BACKEND") or cls.cold_backend
+            ),
+            exemplars=_env_bool("SIEVE_SVC_EXEMPLARS", "1"),
+            exemplar_slack=_env_float(
+                "SIEVE_SVC_EXEMPLAR_SLACK", cls.exemplar_slack
+            ),
+            exemplar_baseline=_env_int(
+                "SIEVE_SVC_EXEMPLAR_BASELINE", cls.exemplar_baseline
+            ),
+            exemplar_window=_env_int(
+                "SIEVE_SVC_EXEMPLAR_WINDOW", cls.exemplar_window
+            ),
+            exemplar_warmup=_env_int(
+                "SIEVE_SVC_EXEMPLAR_WARMUP", cls.exemplar_warmup
+            ),
+            exemplar_ring=_env_int(
+                "SIEVE_SVC_EXEMPLAR_RING", cls.exemplar_ring
+            ),
+            exemplar_file_bytes=_env_int(
+                "SIEVE_SVC_EXEMPLAR_FILE_BYTES", cls.exemplar_file_bytes
             ),
         )
         return dataclasses.replace(s, **overrides)
@@ -1104,6 +1158,8 @@ _STATS = (
     "batch_members",
     "slow_consumer_closed",
     "wire_v2_conns",
+    "exemplars_seen",
+    "exemplars_kept",
 )
 
 
@@ -1324,6 +1380,24 @@ class SieveService:
                 logger=self.metrics,
                 cooldown_s=self.settings.debug_cooldown_s,
             )
+        # tail-sampled exemplars (ISSUE 19): completion-time retention of
+        # span trees — errors/demotions always, the slow tail past the
+        # sampler's own rolling p95 x slack, and a 1-in-N healthy
+        # baseline. Served inline by the ``exemplars`` wire op; persisted
+        # to a rolling exemplars.jsonl when debug_dir is set.
+        self.exemplar: ExemplarSampler | None = None
+        if self.settings.exemplars:
+            self.exemplar = ExemplarSampler(
+                "service",
+                slack=self.settings.exemplar_slack,
+                baseline=self.settings.exemplar_baseline,
+                window=self.settings.exemplar_window,
+                warmup=self.settings.exemplar_warmup,
+                ring=self.settings.exemplar_ring,
+                file_bytes=self.settings.exemplar_file_bytes,
+                debug_dir=self.settings.debug_dir,
+                logger=self.metrics,
+            )
 
     # --- lifecycle -------------------------------------------------------
 
@@ -1397,6 +1471,10 @@ class SieveService:
         if self.recorder is not None:
             self.history.start()
             self.recorder.install()
+        if self.exemplar is not None:
+            # arm the process tracer's exemplar span ring (independent of
+            # full event capture — ``trace.enable`` stays off)
+            trace.get_tracer().exemplar_enable(EXEMPLAR_SPAN_RING)
         return self
 
     def drain(self) -> None:
@@ -1466,6 +1544,8 @@ class SieveService:
         self.cold.close()
         if self.store is not None:
             self.store.close()
+        if self.exemplar is not None:
+            self.exemplar.close()
         if self.recorder is not None:
             self.recorder.uninstall()
             self.history.stop()
@@ -2066,6 +2146,23 @@ class SieveService:
                            if self.recorder is not None else None),
             }, front=True)
             return None
+        if mtype == "exemplars":
+            # tail-sampled exemplar pull (ISSUE 19): the kept-exemplar
+            # ring, inline from the event loop (in-memory only — the
+            # rolling file is the sampler's own concern). ``ctx`` prefix
+            # filter is how the router fetches the downstream exemplars
+            # of one slow route.
+            ctx_f = msg.get("ctx")
+            n_f = msg.get("n")
+            self._reply(conn, {
+                "type": "exemplars", "id": rid, "ok": True,
+                "role": "service",
+                "exemplars": (self.exemplar.tail(
+                    n=n_f if isinstance(n_f, int) else None,
+                    ctx_prefix=ctx_f if isinstance(ctx_f, str) else None,
+                ) if self.exemplar is not None else []),
+            }, front=True)
+            return None
         if mtype == "telemetry":
             # explicit ring flush: the router pulls this from every
             # replica when its trace closes, collecting whatever the
@@ -2152,7 +2249,7 @@ class SieveService:
                 self.metrics.event("service_slow_frame", quietable=True,
                                    bytes_per_tick=conn.throttle_bps)
         if any(d["kind"] == "svc_shed" for d in directives):
-            self._shed(conn, rid, op, forced=True)
+            self._shed(conn, rid, op, forced=True, ctx=msg.get("ctx"))
             return None
         flood = next(
             (d for d in directives if d["kind"] == "svc_flood"), None
@@ -2164,7 +2261,7 @@ class SieveService:
             # event, ReplicaSet failover) without a real 20-thread flood
             self._shed(conn, rid, op, forced=True,
                        lane=str(flood["param"] or "cold"),
-                       chaos_kind="svc_flood")
+                       chaos_kind="svc_flood", ctx=msg.get("ctx"))
             return None
         if self._draining:
             hot, cold = self._lane_depths()
@@ -2187,13 +2284,15 @@ class SieveService:
         if not self._lane_put(lane, item):
             with self._inflight_lock:
                 self._inflight_n -= 1
-            self._shed(conn, rid, op, forced=False, lane=lane)
+            self._shed(conn, rid, op, forced=False, lane=lane,
+                       ctx=msg.get("ctx"))
             return None
         self._bump(f"{lane}_admitted")
         return None
 
     def _shed(self, conn: _Conn, rid, op: str, forced: bool,
-              lane: str | None = None, chaos_kind: str = "svc_shed") -> None:
+              lane: str | None = None, chaos_kind: str = "svc_shed",
+              ctx: Any = None) -> None:
         hot, cold = self._lane_depths()
         depth = hot + cold
         self._bump("shed")
@@ -2223,6 +2322,19 @@ class SieveService:
         }
         if lane is not None:
             reply["lane"] = lane
+        # a shed never ran, so there is no span tree — but the typed
+        # outcome is still exemplar-kept (ISSUE 19), so the file records
+        # every refused request alongside the slow ones
+        if self.exemplar is not None:
+            self._bump("exemplars_seen")
+            reason = self.exemplar.decide("overloaded", 0.0)
+            if reason is not None:
+                self._bump("exemplars_kept")
+                self.exemplar.keep({
+                    "ctx": ctx if isinstance(ctx, str) and ctx else None,
+                    "op": op, "outcome": "overloaded", "ms": 0.0,
+                    "lane": lane, "reason": reason, "spans": [],
+                })
         self._reply(conn, reply)
 
     # --- request handling ------------------------------------------------
@@ -2437,6 +2549,26 @@ class SieveService:
         trace.add_span("rpc.query", enq_t, t_end - enq_t, op=op,
                        outcome=outcome, source=source, lane=lane, **tkw)
         self._observe_slo(op, reply["elapsed_ms"])
+        # tail-sampled exemplar (ISSUE 19): now that the outcome is
+        # known, decide whether this request's span tree is kept. The
+        # rpc.query span above is already in the tracer's exemplar ring.
+        if self.exemplar is not None:
+            self._bump("exemplars_seen")
+            reason = self.exemplar.decide(
+                outcome, reply["elapsed_ms"], flagged=demoted,
+            )
+            if reason is not None:
+                self._bump("exemplars_kept")
+                self.exemplar.keep({
+                    "ctx": tctx if tkw else None,
+                    "op": op,
+                    "outcome": outcome,
+                    "ms": reply["elapsed_ms"],
+                    "lane": lane,
+                    "reason": reason,
+                    "spans": (trace.exemplar_collect(tctx)
+                              if tkw else []),
+                })
         # counters/events before the reply: a stats call racing the
         # reply must already see this request accounted for
         if outcome == "ok" and not ctx.cold and not ctx.materialized:

@@ -43,6 +43,7 @@ estimated NTP-style from the RPC legs) before folding them back in with
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
@@ -59,6 +60,18 @@ _EPOCH = time.perf_counter()
 def now_s() -> float:
     """Seconds since the process trace epoch (monotonic)."""
     return time.perf_counter() - _EPOCH
+
+
+def _ex_root(ctx: str) -> str:
+    """The root request context of a span ctx: ``run_id/seq.attempt``,
+    i.e. the first two ``/``-separated components. Child contexts append
+    ``/s<shard>.<call>`` / per-attempt suffixes, so every span of one
+    request tree shares this root — the exemplar ring's bucket key."""
+    first = ctx.find("/")
+    if first < 0:
+        return ctx
+    second = ctx.find("/", first + 1)
+    return ctx if second < 0 else ctx[:second]
 
 
 class Span:
@@ -112,6 +125,23 @@ class Tracer:
         # counter track identically from periodic samples.
         self._counter_interval_us = 10_000.0
         self._counter_seen: dict[str, float] = {}
+        # exemplar capture (ISSUE 19): a SECOND bounded ring holding only
+        # request-scoped spans (args carry a ``ctx``), fed regardless of
+        # ``enabled`` — tail sampling must see every request's spans
+        # without turning on full event capture (which would also arm
+        # telemetry piggybacks and --trace side effects). 0 disables.
+        # Spans are bucketed by their root request context so a keep's
+        # :meth:`exemplar_collect` touches one request's spans, not the
+        # whole ring — collection runs on the request's critical path
+        # and must stay O(request), not O(ring). Eviction drops whole
+        # oldest-request buckets (a request's tree lives and dies
+        # together).
+        self._ex_limit = 0  # guard: _lock
+        self._ex_spans: collections.OrderedDict[str, list[tuple]] = \
+            collections.OrderedDict()  # guard: _lock — root ctx ->
+        #   [(name, t0, t1, tid, ctx, arg_pairs), ...] raw span tuples
+        self._ex_count = 0  # guard: _lock
+        self._ex_dropped = 0  # guard: _lock
 
     # --- recording -----------------------------------------------------------
 
@@ -130,6 +160,26 @@ class Tracer:
             tot[1] += 1
             if self.enabled:
                 self._append_event(name, t0, t1, args)
+            if self._ex_limit and args and "ctx" in args:
+                root = _ex_root(str(args["ctx"]))
+                bucket = self._ex_spans.get(root)
+                if bucket is None:
+                    bucket = self._ex_spans[root] = []
+                # raw tuple, not the trace-event dict: this branch runs
+                # on every ctx-carrying span of every served request, so
+                # the dict literal + round()s are deferred to the rare
+                # collect. args is flattened to a tuple of pairs so the
+                # whole entry is atomic-only — CPython untracks such
+                # tuples at the first GC scan, which keeps a full 2048-
+                # entry ring from turning every young-gen collection
+                # into a scan of the ring's churn (measured as a ~25%
+                # sequential-QPS hit when entries held live dicts).
+                bucket.append((
+                    name, t0, t1, threading.get_ident(),
+                    str(args["ctx"]), tuple(args.items()),
+                ))
+                self._ex_count += 1
+                self._ex_trim_locked()
 
     def add_span(
         self, name: str, t0: float, duration_s: float, **args: Any
@@ -260,6 +310,9 @@ class Tracer:
             self._tids_named.clear()
             self._counter_seen.clear()
             self._dropped = 0
+            self._ex_spans.clear()
+            self._ex_count = 0
+            self._ex_dropped = 0
 
     def set_event_limit(self, max_events: int | None) -> None:
         """Bound the capture buffer to ``max_events`` (None = unbounded).
@@ -322,6 +375,93 @@ class Tracer:
             return []
         with self._lock:
             return self._events[-n:]
+
+    # --- exemplar capture (ISSUE 19) -------------------------------------
+
+    def _ex_trim_locked(self) -> None:
+        while self._ex_count > self._ex_limit:
+            root = next(iter(self._ex_spans))
+            bucket = self._ex_spans[root]
+            if len(self._ex_spans) == 1:
+                # one giant request owns the whole ring: age its oldest
+                # spans individually instead of dropping its live tree
+                n_drop = self._ex_count - self._ex_limit
+                del bucket[:n_drop]
+                self._ex_count -= n_drop
+                self._ex_dropped += n_drop
+                return
+            del self._ex_spans[root]
+            self._ex_count -= len(bucket)
+            self._ex_dropped += len(bucket)
+
+    def exemplar_enable(self, limit: int) -> None:
+        """Arm the exemplar ring: keep the newest ``limit`` ctx-carrying
+        spans for tail sampling. Independent of :meth:`enable` — full
+        event capture stays off. ``limit <= 0`` disarms and clears."""
+        with self._lock:
+            self._ex_limit = max(0, limit)
+            if self._ex_limit == 0:
+                self._ex_spans.clear()
+                self._ex_count = 0
+            else:
+                self._ex_trim_locked()
+
+    def exemplar_disable(self) -> None:
+        self.exemplar_enable(0)
+
+    def exemplar_collect(self, ctx_prefix: str | None = None) -> list[dict]:
+        """Spans in the exemplar ring whose ``args.ctx`` starts with
+        ``ctx_prefix`` (all of them when None), oldest request first.
+        Non-draining: a request's spans stay visible to a later
+        ``exemplars`` wire pull until the ring ages them out. Runs on
+        the keep path, so only the candidate request buckets are
+        scanned — the prefix narrows to one root for the full request
+        contexts the samplers pass."""
+        pid = os.getpid()
+
+        def mat(e: tuple) -> dict:
+            name, t0, t1, tid, _ctx, pairs = e
+            return {
+                "name": name,
+                "ph": "X",
+                "ts": round(t0 * 1e6, 3),
+                "dur": round((t1 - t0) * 1e6, 3),
+                "pid": pid,
+                "tid": tid,
+                "args": dict(pairs),
+            }
+
+        with self._lock:
+            if ctx_prefix is None:
+                return [mat(e) for bucket in self._ex_spans.values()
+                        for e in bucket]
+            if "/" in ctx_prefix:
+                # a full request ctx (or deeper): every matching span's
+                # root IS _ex_root(ctx_prefix), so the whole collect is
+                # one dict lookup — this is the keep-path shape, and it
+                # must stay O(one request), not O(ring)
+                bucket = self._ex_spans.get(_ex_root(ctx_prefix))
+                if not bucket:
+                    return []
+                return [
+                    mat(e) for e in bucket
+                    if e[4].startswith(ctx_prefix)
+                ]
+            # a bare run-id prefix can span many request buckets: scan
+            out: list[dict] = []
+            for root, bucket in self._ex_spans.items():
+                if not root.startswith(ctx_prefix):
+                    continue
+                out.extend(
+                    mat(e) for e in bucket
+                    if e[4].startswith(ctx_prefix)
+                )
+            return out
+
+    @property
+    def exemplar_dropped(self) -> int:
+        with self._lock:
+            return self._ex_dropped
 
     def save(self, path_or_file: str | TextIO) -> None:
         """Write the captured events as Chrome trace-event JSON."""
@@ -458,6 +598,14 @@ def ingest(events: list[dict]) -> None:
 
 def tail(n: int) -> list[dict]:
     return _TRACER.tail(n)
+
+
+def exemplar_enable(limit: int) -> None:
+    _TRACER.exemplar_enable(limit)
+
+
+def exemplar_collect(ctx_prefix: str | None = None) -> list[dict]:
+    return _TRACER.exemplar_collect(ctx_prefix)
 
 
 def snapshot() -> dict[str, tuple[float, int]]:

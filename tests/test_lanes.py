@@ -169,6 +169,7 @@ def test_health_and_stats_key_schema_snapshot(service):
         "covered_hi",
         "deadline_exceeded", "degraded", "degraded_replies", "demoted",
         "draining", "draining_replies", "dropped_segments",
+        "exemplars_kept", "exemplars_seen",
         "hot_admitted", "hot_workers_dedicated", "index_hits",
         "internal_errors", "lane_shed_cold", "lane_shed_hot",
         "lru_entries", "lru_hits", "materialized", "mesh_devices",

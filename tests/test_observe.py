@@ -1,0 +1,498 @@
+"""Capacity observatory (ISSUE 19): tail-sampled exemplar retention
+(100% typed-error keep, rolling-p95 slow tail, deterministic healthy
+baseline, ring + rolling-file sinks), the CRC'd on-disk
+:class:`SnapshotRing` (torn-tail trim, compaction cap, racing-reader
+tolerance), :func:`derive_signals` (counter deltas are 0.0 on the first
+sample — never fabricated), the gap-aware EWMA + robust z-score anomaly
+engine (warmup arming, edge-triggered fleet_anomaly with bundle pull,
+scrape gaps disarm and never alarm), scaling advisories, the
+``svc_scrape_gap`` chaos grammar, ObserverSettings validation/env
+plumbing, EVENT_SCHEMA coverage of the five new events, and
+tools/observe_smoke.py as the tier-1 subprocess acceptance gate
+(2-shard fleet, injected regression -> exactly one anomaly, zero false
+alarms across the gap window, exemplar files hold the stalled span
+trees).
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import zlib
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from sieve.chaos import (  # noqa: E402
+    DEFAULT_PARAM,
+    KINDS,
+    OBSERVER_KINDS,
+    ChaosSchedule,
+    parse_chaos,
+)
+from sieve.metrics import EVENT_SCHEMA, validate_record  # noqa: E402
+from sieve.service.exemplar import (  # noqa: E402
+    EXEMPLAR_FILE,
+    ExemplarSampler,
+    load_exemplars,
+)
+from sieve.service.observe import (  # noqa: E402
+    ANOMALY_SIGNALS,
+    RING_FILE,
+    FleetObserver,
+    ObserverSettings,
+    SnapshotRing,
+    derive_signals,
+    read_ring,
+)
+
+_REC_HEADER = struct.Struct("<III")
+_REC_MAGIC = 0x53524E47
+
+
+# --- exemplar sampler --------------------------------------------------------
+
+
+def test_sampler_keeps_every_typed_error():
+    s = ExemplarSampler("service", baseline=10**9)
+    for outcome in ("deadline_exceeded", "overloaded", "degraded",
+                    "draining", "internal", "unavailable"):
+        assert s.decide(outcome, 0.1) == "error"
+
+
+def test_sampler_flagged_keeps_healthy_outcome():
+    s = ExemplarSampler("service", baseline=10**9)
+    s.decide("ok", 1.0)  # burn the first-request baseline
+    assert s.decide("ok", 1.0, flagged=True) == "flagged"
+    assert s.decide("ok", 1.0) is None
+
+
+def test_sampler_baseline_is_deterministic_one_in_n():
+    s = ExemplarSampler("service", baseline=5, warmup=10**9)
+    reasons = [s.decide("ok", 1.0) for _ in range(20)]
+    assert [i for i, r in enumerate(reasons) if r == "baseline"] == \
+        [0, 5, 10, 15]
+
+
+def test_sampler_slow_rule_arms_after_warmup_only():
+    # a cold window has no percentile: even an outlier is dropped
+    cold = ExemplarSampler("service", slack=2.0, warmup=10,
+                           baseline=10**9)
+    cold.decide("ok", 1.0)  # request 1 is always the baseline exemplar
+    assert cold.decide("ok", 100.0) is None  # 1 obs < warmup: not armed
+    # armed after warmup healthy observations; p95 from obs BEFORE the
+    # request under decision, so it cannot excuse itself
+    s = ExemplarSampler("service", slack=2.0, warmup=10, baseline=10**9)
+    assert s.decide("ok", 1.0) == "baseline"
+    for _ in range(9):
+        assert s.decide("ok", 1.0) is None
+    assert s.decide("ok", 100.0) == "slow"  # p95 ~1.0, 100 > 1.0 * 2
+
+
+def test_sampler_error_storm_does_not_move_the_slow_threshold():
+    s = ExemplarSampler("service", slack=2.0, warmup=5, baseline=10**9)
+    for _ in range(6):
+        s.decide("ok", 1.0)
+    for _ in range(50):  # a deadline storm of huge latencies, all errors
+        assert s.decide("deadline_exceeded", 5000.0) == "error"
+    assert s.decide("ok", 3.0) == "slow"  # p95 still ~1.0 from healthy obs
+
+
+def test_sampler_keep_ring_file_and_rotation(tmp_path):
+    s = ExemplarSampler("router", ring=2, file_bytes=200,
+                        debug_dir=str(tmp_path))
+    for i in range(5):
+        s.keep({"ctx": f"run/{i}.0", "op": "pi", "outcome": "ok",
+                "ms": 1.0, "reason": "baseline", "spans": []})
+    assert [r["ctx"] for r in s.tail()] == ["run/3.0", "run/4.0"]
+    assert s.tail(ctx_prefix="run/4") == [s.tail()[-1]]
+    assert s.tail()[0]["role"] == "router"
+    # file_bytes=200 < two records: every append rotates, so exactly
+    # one generation of history survives next to the live file
+    # (appends run on the sampler's writer thread — drain it first)
+    s.flush()
+    live = load_exemplars(str(tmp_path / EXEMPLAR_FILE))
+    rotated = load_exemplars(str(tmp_path / (EXEMPLAR_FILE + ".1")))
+    assert [r["ctx"] for r in live] == ["run/4.0"]
+    assert [r["ctx"] for r in rotated] == ["run/3.0"]
+    st = s.stats()
+    assert (st["kept"], st["ring"]) == (5, 2)
+
+
+def test_load_exemplars_skips_torn_tail(tmp_path):
+    p = tmp_path / EXEMPLAR_FILE
+    p.write_text(json.dumps({"ctx": "a"}) + "\n" + '{"ctx": "tor')
+    assert [r["ctx"] for r in load_exemplars(str(p))] == ["a"]
+
+
+# --- the on-disk snapshot ring -----------------------------------------------
+
+
+def _ring_path(tmp_path):
+    return str(tmp_path / RING_FILE)
+
+
+def test_ring_append_read_roundtrip(tmp_path):
+    ring = SnapshotRing(_ring_path(tmp_path))
+    for i in range(7):
+        ring.append({"scrape": i})
+    assert [r["scrape"] for r in read_ring(_ring_path(tmp_path))] == \
+        list(range(7))
+    assert ring.records(2) == [{"scrape": 5}, {"scrape": 6}]
+
+
+def test_ring_reader_stops_at_torn_tail_and_open_trims_it(tmp_path):
+    path = _ring_path(tmp_path)
+    ring = SnapshotRing(path)
+    ring.append({"scrape": 1})
+    ring.append({"scrape": 2})
+    with open(path, "ab") as f:
+        f.write(_REC_HEADER.pack(_REC_MAGIC, 500, 0) + b"short")
+    # a concurrent reader never crashes on the half-written tail
+    assert [r["scrape"] for r in read_ring(path)] == [1, 2]
+    reopened = SnapshotRing(path)  # crash-restart trims the torn frame
+    assert reopened.torn == 1
+    assert [r["scrape"] for r in read_ring(path)] == [1, 2]
+    reopened.append({"scrape": 3})
+    assert [r["scrape"] for r in read_ring(path)] == [1, 2, 3]
+
+
+def test_ring_reader_stops_at_bad_crc(tmp_path):
+    path = _ring_path(tmp_path)
+    ring = SnapshotRing(path)
+    ring.append({"scrape": 1})
+    payload = json.dumps({"scrape": 2}).encode()
+    with open(path, "ab") as f:
+        f.write(_REC_HEADER.pack(_REC_MAGIC, len(payload),
+                                 zlib.crc32(payload) ^ 0xFF) + payload)
+    assert [r["scrape"] for r in read_ring(path)] == [1]
+
+
+def test_ring_compaction_keeps_newest_under_half_cap(tmp_path):
+    path = _ring_path(tmp_path)
+    ring = SnapshotRing(path, cap_bytes=2048)
+    for i in range(100):
+        ring.append({"scrape": i, "pad": "x" * 40})
+    assert ring.compactions >= 1
+    assert os.path.getsize(path) <= 2048
+    recs = read_ring(path)
+    assert recs  # newest survive, oldest are gone, order preserved
+    assert [r["scrape"] for r in recs] == \
+        list(range(100 - len(recs), 100))
+
+
+# --- signal derivation -------------------------------------------------------
+
+
+def test_derive_signals_first_sample_is_never_fabricated():
+    sig = derive_signals(
+        "service", {"covered_hi": 1000},
+        {"hot_admitted": 500, "queue_depth": 3}, None, None)
+    assert sig["hot_qps"] == 0.0  # a trend needs two points
+    assert sig["covered_rate"] == 0.0
+    assert sig["lane_depth"] == 3.0  # instantaneous reads are fine
+
+
+def test_derive_signals_service_deltas_over_dt():
+    prev = {"hot_admitted": 100, "cold_admitted": 10, "shed": 0,
+            "lane_shed_hot": 0, "lane_shed_cold": 2,
+            "deadline_exceeded": 1, "internal_errors": 0,
+            "degraded_replies": 0, "_covered_hi": 1000}
+    cur = {"hot_admitted": 150, "cold_admitted": 20, "shed": 4,
+           "lane_shed_hot": 1, "lane_shed_cold": 3,
+           "deadline_exceeded": 3, "internal_errors": 1,
+           "degraded_replies": 0, "queue_depth": 7,
+           "store": {"hits": 30, "misses": 10},
+           "slo": {"hot": {"burn": 0.25}, "cold": {"burn": 1.5}}}
+    sig = derive_signals("service", {"covered_hi": 3000}, cur, prev, 2.0)
+    assert sig["hot_qps"] == 25.0
+    assert sig["cold_qps"] == 5.0
+    assert sig["shed_rate"] == pytest.approx(3.0)  # (4+1+3)-(0+0+2) over 2s
+    assert sig["err_rate"] == pytest.approx(1.5)
+    assert sig["lane_depth"] == 7.0
+    assert sig["slo_burn"] == 1.5  # worst lane
+    assert sig["store_hit"] == 0.75
+    assert sig["covered_rate"] == pytest.approx(1000.0)
+
+
+def test_derive_signals_router_uses_router_counters():
+    prev = {"requests": 10, "shed_relayed": 0, "deadline_exceeded": 0,
+            "internal_errors": 0, "shard_errors": 0,
+            "unavailable_replies": 0}
+    cur = {"requests": 30, "shed_relayed": 4, "deadline_exceeded": 1,
+           "internal_errors": 0, "shard_errors": 1,
+           "unavailable_replies": 2}
+    sig = derive_signals("router", {}, cur, prev, 2.0)
+    assert sig["hot_qps"] == 10.0
+    assert sig["shed_rate"] == 2.0
+    assert sig["err_rate"] == 2.0
+
+
+# --- the anomaly engine (faked fleet, manual clock) --------------------------
+
+
+class _FakeClient:
+    """Programmable health/stats endpoint standing in for a live RPC."""
+
+    def __init__(self):
+        self.health_doc = {"covered_hi": 0}
+        self.stats_doc = {}
+        self.debug_calls = 0
+
+    def health(self):
+        return dict(self.health_doc)
+
+    def stats(self):
+        return dict(self.stats_doc)
+
+    def debug(self):
+        self.debug_calls += 1
+        return {"recorder": "state"}
+
+
+class _FakePool:
+    def __init__(self, clients):
+        self.clients = clients
+
+    def get(self, addr):
+        cli = self.clients[addr]
+        if isinstance(cli, Exception):
+            raise cli
+        return cli
+
+    def invalidate(self, addr):
+        pass
+
+    def close(self):
+        pass
+
+
+def _observer(tmp_path, monkeypatch, clients, *, chaos=None, **over):
+    """A FleetObserver over faked endpoints with a hand-cranked clock."""
+    clock = {"t": 1000.0}
+    monkeypatch.setattr("time.time", lambda: clock["t"])
+    knobs = dict(warmup=3, min_delta=2.0, z_threshold=6.0, alpha=0.3,
+                 cooldown_s=1e9, observe_dir=str(tmp_path), quiet=True)
+    knobs.update(over)
+    obs = FleetObserver("r:0", ObserverSettings(**knobs), chaos=chaos)
+    obs.pool = _FakePool(clients)
+    targets = [
+        {"role": "router" if a == "r:0" else "shard", "addr": a,
+         "shard": None if a == "r:0" else i - 1}
+        for i, a in enumerate(clients)
+    ]
+    monkeypatch.setattr(obs, "_discover", lambda: list(targets))
+
+    def tick(dt=1.0):
+        clock["t"] += dt
+        return obs.scrape_once()
+
+    return obs, tick
+
+
+def test_anomaly_requires_warmup_then_edge_triggers_once(
+        tmp_path, monkeypatch):
+    # an immediate spike on a COLD endpoint must not alarm (not armed)
+    cold_svc = _FakeClient()
+    cold_svc.stats_doc = {"queue_depth": 80}
+    cold, cold_tick = _observer(tmp_path / "cold", monkeypatch,
+                                {"r:0": _FakeClient(), "s:0": cold_svc})
+    assert cold_tick()["anomalies"] == []
+    assert cold.stats()["anomalies"] == 0
+    # a calm warmup then the same spike: exactly one fleet_anomaly
+    svc = _FakeClient()
+    svc.stats_doc = {"queue_depth": 0}
+    obs, tick = _observer(tmp_path / "armed", monkeypatch,
+                          {"r:0": _FakeClient(), "s:0": svc})
+    for _ in range(6):  # settle: warmup consecutive calm samples
+        assert tick()["anomalies"] == []
+    svc.stats_doc = {"queue_depth": 50}  # lane_depth excursion, dev ~0
+    snap = tick()
+    assert obs.stats()["anomalies"] == 1
+    [evid] = [a for a in snap["anomalies"] if a["signal"] == "lane_depth"]
+    assert evid["addr"] == "s:0" and evid["value"] == 50.0
+    assert evid["z"] > 6.0
+    # edge trigger: the breach persisting does not re-fire in cooldown
+    tick()
+    assert obs.stats()["anomalies"] == 1
+    # the ring row carries the full evidence for fleet_top/postmortems
+    rows = read_ring(str(tmp_path / "armed" / RING_FILE))
+    assert rows[-2]["anomalies"][0]["signal"] == "lane_depth"
+
+
+def test_anomaly_fires_fleet_wide_bundle_pull(tmp_path, monkeypatch):
+    router, svc = _FakeClient(), _FakeClient()
+    obs, tick = _observer(tmp_path, monkeypatch,
+                          {"r:0": router, "s:0": svc})
+    for _ in range(6):
+        tick()
+    svc.stats_doc = {"queue_depth": 50}
+    tick()
+    assert obs.stats()["anomalies"] == 1
+    bundles = [f for f in os.listdir(tmp_path)
+               if f.startswith("anomaly_")]
+    assert len(bundles) == 1
+    doc = json.loads((tmp_path / bundles[0]).read_text())
+    assert doc["trigger"] == "fleet_anomaly"
+    assert {p["addr"] for p in doc["processes"]} == {"r:0", "s:0"}
+    assert all(p["bundle"] == {"recorder": "state"}
+               for p in doc["processes"])
+    assert router.debug_calls == 1 and svc.debug_calls == 1
+
+
+def test_scrape_gap_counts_disarms_and_never_false_alarms(
+        tmp_path, monkeypatch):
+    svc = _FakeClient()
+    chaos = ChaosSchedule(parse_chaos("svc_scrape_gap:any@s4"))
+    obs, tick = _observer(tmp_path, monkeypatch,
+                          {"r:0": _FakeClient(), "s:0": svc},
+                          chaos=chaos)
+    svc.stats_doc = {"hot_admitted": 0, "queue_depth": 0}
+    for _ in range(3):
+        tick()
+    snap = tick()  # scrape 4: the chaos draw eats the router poll
+    assert obs.stats()["gaps"] == 1
+    gap_rows = [t for t in snap["targets"] if t["gap"]]
+    assert [t["addr"] for t in gap_rows] == ["r:0"]
+    assert gap_rows[0]["gap"] == "svc_scrape_gap"
+    assert "signals" not in gap_rows[0]  # a gap is never a sample
+    # the sample right after the gap re-seeds the baseline: even a huge
+    # counter jump on the gapped endpoint cannot alarm
+    obs.pool.clients["r:0"].stats_doc = {"requests": 10**7}
+    for _ in range(3):  # within warmup after the reset
+        assert tick()["anomalies"] == []
+    assert obs.stats()["anomalies"] == 0
+
+
+def test_unreachable_endpoint_is_a_named_gap_not_a_sample(
+        tmp_path, monkeypatch):
+    obs, tick = _observer(
+        tmp_path, monkeypatch,
+        {"r:0": _FakeClient(), "s:0": ConnectionRefusedError("down")})
+    snap = tick()
+    [row] = [t for t in snap["targets"] if t["addr"] == "s:0"]
+    assert row["gap"] == "ConnectionRefusedError"
+    assert obs.stats()["gaps"] == 1
+    assert snap["anomalies"] == []
+
+
+def test_scaling_advice_add_replica_on_sustained_shed(
+        tmp_path, monkeypatch):
+    svc0, svc1 = _FakeClient(), _FakeClient()
+    obs, tick = _observer(tmp_path, monkeypatch,
+                          {"r:0": _FakeClient(), "s:0": svc0,
+                           "s:1": svc1},
+                          z_threshold=1e9)  # isolate the advice path
+    shed = {"hot_admitted": 0, "shed": 0}
+    for i in range(8):  # sustained shedding on shard 0 only
+        shed = {"hot_admitted": shed["hot_admitted"] + 10,
+                "shed": shed["shed"] + 5}
+        svc0.stats_doc = shed
+        svc1.stats_doc = {"hot_admitted": (i + 1) * 10}
+        snap = tick()
+    advice = [a for a in snap["advice"] if a["advice"] == "add_replica"]
+    assert advice == [] or advice[0]["shard"] == 0
+    all_advice = [a for row in read_ring(str(tmp_path / RING_FILE))
+                  for a in row["advice"]]
+    fired = [a for a in all_advice if a["advice"] == "add_replica"]
+    assert len(fired) == 1  # edge-triggered: once per cooldown window
+    assert fired[0]["shard"] == 0 and fired[0]["shed_rate"] > 0.5
+
+
+def test_observer_stats_shape(tmp_path, monkeypatch):
+    obs, tick = _observer(tmp_path, monkeypatch, {"r:0": _FakeClient()})
+    tick()
+    st = obs.stats()
+    assert st["scrapes"] == 1 and st["endpoints"] == 1
+    assert st["ring"]["appended"] == 1
+
+
+# --- chaos grammar -----------------------------------------------------------
+
+
+def test_svc_scrape_gap_is_a_first_class_chaos_kind():
+    assert "svc_scrape_gap" in KINDS
+    assert OBSERVER_KINDS == ("svc_scrape_gap",)
+    assert DEFAULT_PARAM["svc_scrape_gap"] is None
+    [d] = parse_chaos("svc_scrape_gap:any@s7")
+    assert (d.kind, d.seg_id) == ("svc_scrape_gap", 7)
+    sched = ChaosSchedule([d])
+    assert sched.take_kinds(0, 6, OBSERVER_KINDS) == []
+    [hit] = sched.take_kinds(2, 7, OBSERVER_KINDS)  # any worker matches
+    assert hit["kind"] == "svc_scrape_gap"
+    assert sched.take_kinds(2, 7, OBSERVER_KINDS) == []  # one-shot
+
+
+# --- settings ----------------------------------------------------------------
+
+
+def test_observer_settings_validate_rejects_bad_knobs():
+    good = ObserverSettings()
+    assert good.validate() is good
+    import dataclasses as dc
+    for bad in (
+        {"scrape_s": 0}, {"scrape_s": -1.0}, {"timeout_s": 0},
+        {"cooldown_s": -1.0}, {"ring_bytes": 0}, {"ring_bytes": 1.5},
+        {"warmup": -1}, {"warmup": 2.5}, {"alpha": 0.0},
+        {"alpha": 1.5}, {"z_threshold": -1.0}, {"min_delta": -0.1},
+        {"observe_dir": 42},
+    ):
+        with pytest.raises(ValueError):
+            dc.replace(good, **bad).validate()
+
+
+def test_observer_settings_from_env(monkeypatch):
+    monkeypatch.setenv("SIEVE_OBSERVE_SCRAPE_S", "0.25")
+    monkeypatch.setenv("SIEVE_OBSERVE_Z", "9.5")
+    monkeypatch.setenv("SIEVE_OBSERVE_WARMUP", "4")
+    monkeypatch.setenv("SIEVE_OBSERVE_RING_BYTES", "65536")
+    s = ObserverSettings.from_env(observe_dir="/tmp/x")
+    assert (s.scrape_s, s.z_threshold, s.warmup, s.ring_bytes) == \
+        (0.25, 9.5, 4, 65536)
+    assert s.observe_dir == "/tmp/x"  # explicit override beats env
+
+
+# --- event schema ------------------------------------------------------------
+
+
+def test_new_observatory_events_are_in_the_schema():
+    for kind in ("service_exemplar_kept", "observer_scrape_gap",
+                 "fleet_anomaly", "scaling_advice", "observer_error"):
+        assert kind in EVENT_SCHEMA
+
+
+def test_observatory_event_records_validate():
+    validate_record({
+        "event": "fleet_anomaly", "ts": 1.0, "addr": "h:1",
+        "signal": "err_rate", "value": 5.0, "mean": 0.0, "dev": 0.0,
+        "z": 1e6, "scrape": 9, "bundle": None,
+    })
+    validate_record({
+        "event": "observer_scrape_gap", "ts": 1.0, "addr": "h:1",
+        "scrape": 5, "gap": "svc_scrape_gap",
+    })
+    validate_record({
+        "event": "scaling_advice", "ts": 1.0, "advice": "split",
+        "shard": 0, "qps": 10.0, "shed_rate": 0.0, "share": 0.7,
+        "scrape": 4,
+    })
+    with pytest.raises(ValueError):
+        validate_record({"event": "fleet_anomaly", "ts": 1.0})
+
+
+# --- the subprocess acceptance gate ------------------------------------------
+
+
+def test_observe_smoke_tool(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "observe_smoke.py"),
+         "--keep", str(tmp_path / "work")],
+        env=env, cwd=str(REPO), capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "OBSERVE_SMOKE_OK" in proc.stdout

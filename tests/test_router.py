@@ -514,7 +514,8 @@ def test_router_health_and_stats_key_schema_snapshot(src_dirs, tmp_path):
         assert sorted(st) == [
             "bad_requests", "batch_members", "batch_requests",
             "batch_rpcs", "deadline_exceeded", "draining",
-            "draining_replies", "failovers", "internal_errors", "probes",
+            "draining_replies", "exemplar_pulls", "exemplars_kept",
+            "exemplars_seen", "failovers", "internal_errors", "probes",
             "range_hi", "range_lo", "requests", "routed_point",
             "scattered", "shard_count", "shard_down_windows",
             "shard_errors", "shed_relayed", "spliced",

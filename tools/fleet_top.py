@@ -38,8 +38,12 @@ from typing import Any
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from sieve.service.client import ClientPool, ServiceClient  # noqa: E402
+from tools.trace_report import _sparkline  # noqa: E402
 
 _CLEAR = "\x1b[2J\x1b[H"
+
+# snapshots of trend history per sparkline cell (--observe-dir)
+_TREND_DEPTH = 30
 
 
 def _poll(addr: str, timeout_s: float,
@@ -116,6 +120,33 @@ def fleet_ok(snap: dict) -> bool:
             if rep["health"] is None:
                 return False
     return True
+
+
+def ring_trends(observe_dir: str,
+                depth: int = _TREND_DEPTH) -> dict[str, dict[str, list]]:
+    """Per-endpoint signal series from the observer's snapshot ring
+    (ISSUE 19): ``{addr: {signal: [newest depth values...]}}``. The
+    observer daemon persists the ring; this reader tolerates a racing
+    appender (torn tails skip) and an absent file (empty trends)."""
+    from sieve.service.observe import RING_FILE, read_ring
+
+    out: dict[str, dict[str, list]] = {}
+    path = os.path.join(observe_dir, RING_FILE)
+    for snap in read_ring(path)[-depth:]:
+        for tgt in snap.get("targets", []):
+            sig = tgt.get("signals")
+            if not isinstance(sig, dict):
+                continue  # gap row: no fabricated point
+            series = out.setdefault(tgt.get("addr", "?"), {})
+            for name, val in sig.items():
+                series.setdefault(name, []).append(val)
+    return out
+
+
+def _trend_cell(trends: dict | None, addr: str, signal: str) -> str:
+    if not trends or addr not in trends:
+        return "-"
+    return _sparkline(trends[addr].get(signal) or [])
 
 
 def _rate(cur: dict | None, prev: dict | None, key: str,
@@ -195,8 +226,13 @@ def _prev_stats(prev: dict | None, shard: int | None,
     return None
 
 
-def render(snap: dict, prev: dict | None = None) -> str:
-    """One text frame from a :func:`fleet_snapshot` (pure function)."""
+def render(snap: dict, prev: dict | None = None,
+           trends: dict | None = None) -> str:
+    """One text frame from a :func:`fleet_snapshot` (pure function).
+
+    ``trends`` (from :func:`ring_trends`, the ``--observe-dir`` mode)
+    appends per-endpoint hot-qps and shed-rate sparkline columns fed
+    from the observer daemon's snapshot ring."""
     lines: list[str] = []
     dt = (snap["ts"] - prev["ts"]) if prev else None
     r = snap["router"]
@@ -225,11 +261,14 @@ def render(snap: dict, prev: dict | None = None) -> str:
         f"failovers={rs.get('failovers', 0)}"
     )
     lines.append("")
+    trend_hdr = (f" {'hot trend':>{_TREND_DEPTH}} "
+                 f"{'shed trend':>{_TREND_DEPTH}}"
+                 if trends is not None else "")
     lines.append(
         f"  {'replica':<22} {'st':<4} {'hot':>4} {'cold':>4} "
         f"{'shed':>8} {'demote':>8} {'lru':>5} {'ccache':>6} "
         f"{'colddisp':>9} {'cbackend':>10} {'store':>12} "
-        f"{'covered_hi':>11} {'slo burn':>9}"
+        f"{'covered_hi':>11} {'slo burn':>9}" + trend_hdr
     )
     for sh in snap["shards"]:
         for rep in sh["replicas"]:
@@ -253,6 +292,11 @@ def render(snap: dict, prev: dict | None = None) -> str:
             ccache = _ratio(st.get("cold_cache_hits"),
                             (st.get("cold_cache_hits") or 0)
                             + (st.get("cold_dispatches") or 0))
+            trend_cells = (
+                f" {_trend_cell(trends, rep['addr'], 'hot_qps'):>{_TREND_DEPTH}}"
+                f" {_trend_cell(trends, rep['addr'], 'shed_rate'):>{_TREND_DEPTH}}"
+                if trends is not None else ""
+            )
             lines.append(
                 f"  {name:<22} {str(h.get('status', '?'))[:4]:<4} "
                 f"{h.get('queue_depth_hot', 0):>4} "
@@ -263,6 +307,7 @@ def render(snap: dict, prev: dict | None = None) -> str:
                 f"{_cold_cell(st):>10} "
                 f"{_store_cell(st):>12} "
                 f"{h.get('covered_hi', 0):>11} {_worst_burn(st):>9}"
+                + trend_cells
             )
     return "\n".join(lines)
 
@@ -282,6 +327,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="one poll, raw snapshot as a single JSON document; "
                         "exit 1 if any row is DOWN or UNREACHABLE")
+    p.add_argument("--observe-dir", default=None,
+                   help="a `python -m sieve observe` --observe-dir: adds "
+                        "per-replica hot-qps / shed-rate sparkline "
+                        "columns fed from the observer's snapshot ring "
+                        "(ISSUE 19)")
     args = p.parse_args(argv)
     if args.as_json:
         snap = fleet_snapshot(args.router_addr, timeout_s=args.timeout)
@@ -296,7 +346,9 @@ def main(argv: list[str] | None = None) -> int:
             while True:
                 snap = fleet_snapshot(args.router_addr,
                                       timeout_s=args.timeout, pool=pool)
-                frame = render(snap, prev)
+                trends = (ring_trends(args.observe_dir)
+                          if args.observe_dir else None)
+                frame = render(snap, prev, trends=trends)
                 if args.once:
                     print(frame)
                     return 0 if snap["router"]["health"] is not None else 1

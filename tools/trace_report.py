@@ -922,6 +922,76 @@ def bundle_report(doc: dict) -> str:
     return "\n".join(lines)
 
 
+# --- tail-sampled exemplars (ISSUE 19) ----------------------------------
+
+
+def load_exemplar_file(path: str) -> list[dict]:
+    """Exemplar records from an ``exemplars.jsonl`` path or a debug dir
+    holding one (rotated ``.1`` generation included, oldest first)."""
+    from sieve.service.exemplar import EXEMPLAR_FILE, load_exemplars
+
+    if os.path.isdir(path):
+        path = os.path.join(path, EXEMPLAR_FILE)
+    out: list[dict] = []
+    if os.path.exists(path + ".1"):
+        out.extend(load_exemplars(path + ".1"))
+    try:
+        out.extend(load_exemplars(path))
+    except OSError as e:
+        if not out:
+            raise TraceLoadError(f"{path}: {e.strerror or e}") from None
+    if not out:
+        raise TraceLoadError(f"{path}: no exemplar records")
+    return out
+
+
+def exemplar_report(recs: list[dict], top: int = 10) -> str:
+    """Terminal rendering of kept exemplars (pure function): retention
+    breakdown, kept-latency sparkline, then the ``top`` slowest kept
+    requests with their span trees and downstream shard exemplars."""
+    by_reason: dict[str, int] = {}
+    by_outcome: dict[str, int] = {}
+    for r in recs:
+        by_reason[r.get("reason", "?")] = by_reason.get(
+            r.get("reason", "?"), 0) + 1
+        by_outcome[r.get("outcome", "?")] = by_outcome.get(
+            r.get("outcome", "?"), 0) + 1
+    lines = [
+        f"exemplars: {len(recs)} kept",
+        "  by reason:  " + "  ".join(
+            f"{k}={v}" for k, v in sorted(by_reason.items())),
+        "  by outcome: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(by_outcome.items())),
+        "  kept latency (ms, keep order): "
+        + _sparkline([r.get("ms") for r in recs]),
+    ]
+    slow = sorted(recs, key=lambda r: r.get("ms") or 0.0,
+                  reverse=True)[:top]
+    lines.append(f"  slowest {len(slow)} kept:")
+    for r in slow:
+        tag = (f"[{r.get('role', '?')}] {r.get('op', '?'):<10} "
+               f"{(r.get('ms') or 0.0):>9.3f} ms  "
+               f"reason={r.get('reason')} outcome={r.get('outcome')}")
+        if r.get("ctx"):
+            tag += f"  ctx={r['ctx']}"
+        if r.get("shards") is not None:
+            tag += f"  shards={r['shards']}"
+        lines.append(f"    {tag}")
+        for s in (r.get("spans") or [])[-8:]:
+            dur = s.get("dur")
+            dur_ms = f"{dur / 1e3:.3f} ms" if dur is not None else "-"
+            lines.append(f"      {s.get('name', '?'):<28} {dur_ms:>12}")
+        for d in r.get("downstream") or []:
+            lines.append(
+                f"      ↳ shard {d.get('shard', '?')} "
+                f"{d.get('addr', '?')}: {d.get('op', '?')} "
+                f"{(d.get('ms') or 0.0):.3f} ms "
+                f"reason={d.get('reason')} "
+                f"spans={len(d.get('spans') or ())}"
+            )
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         description="summarize a sieve --trace file (Chrome trace-event "
@@ -942,7 +1012,20 @@ def main(argv: list[str] | None = None) -> int:
                    help="render a flight-recorder postmortem bundle "
                         "(bundle.json, fleet_bundle.json, or a bundle "
                         "directory) instead of a trace")
+    p.add_argument("--exemplars", action="store_true",
+                   help="render a tail-sampled exemplar file "
+                        "(exemplars.jsonl or the --debug-dir holding "
+                        "one): retention breakdown + slowest kept span "
+                        "trees (ISSUE 19)")
     args = p.parse_args(argv)
+    if args.exemplars:
+        try:
+            recs = load_exemplar_file(args.trace_file)
+        except TraceLoadError as e:
+            print(f"trace_report: error: {e}", file=sys.stderr)
+            return 1
+        print(exemplar_report(recs, top=args.top))
+        return 0
     if args.bundle:
         try:
             doc = load_bundle(args.trace_file)
