@@ -1136,6 +1136,105 @@ def service_recorder_overhead_metric() -> None:
     )
 
 
+def service_profiler_overhead_metric() -> None:
+    """Continuous-profiler overhead (ISSUE 20): the line-8 mixed
+    workload and methodology — interleaved off/on passes, fresh
+    service per pass, untimed warmup, client-side timing,
+    min-across-reps p95 — with the always-on statistical sampler as
+    the variable: ``prof_hz`` at the production default (19 Hz daemon
+    walking ``sys._current_frames()`` and folding into the bounded
+    collapsed-stack table) vs 0 (no sampler thread at all). Nothing
+    pulls the profile during the workload, so the ratio prices
+    exactly the steady-state tax of leaving the sampler on in every
+    server and router. Every reply asserted exact. Budget: 1.05 —
+    same bar as the trace and recorder planes; always-on means
+    nobody can measure it."""
+    import tempfile
+
+    import numpy as np
+
+    from sieve.config import SieveConfig
+    from sieve.coordinator import run_local
+    from sieve.seed import seed_primes
+    from sieve.service import ServiceClient, ServiceSettings, SieveService
+
+    n = 2_000_000
+    chunk = 1 << 18
+    reps = 25
+    oracle = seed_primes(n + 9 * chunk)
+
+    def o_pi(x: int) -> int:
+        return int(np.searchsorted(oracle, x, side="right"))
+
+    def workload(cli: ServiceClient, timings: list[float]) -> None:
+        def timed(fn, *a):
+            t0 = time.perf_counter()
+            out = fn(*a)
+            timings.append((time.perf_counter() - t0) * 1e3)
+            return out
+
+        for i in range(150):  # hot: prefix counts
+            x = (7919 * (i + 1)) % n
+            assert timed(cli.pi, x) == o_pi(x), f"pi({x}) parity failure"
+        for i in range(50):   # hot: windowed counts (materialize tier)
+            lo = (104_729 * (i + 1)) % (n - 60_000)
+            want = o_pi(lo + 50_000 - 1) - o_pi(lo - 1)
+            assert timed(cli.count, lo, lo + 50_000) == want, \
+                f"count({lo}) parity failure"
+        for i in range(8):    # cold: one fresh chunk each, batched
+            x = n + (i + 1) * chunk - 1
+            assert timed(cli.pi, x) == o_pi(x), f"cold pi({x}) parity"
+
+    with tempfile.TemporaryDirectory(prefix="sieve_bench_prof") as ck:
+        cfg = SieveConfig(
+            n=n, backend="cpu-numpy", packing="odds", n_segments=8,
+            checkpoint_dir=ck, quiet=True,
+        )
+        run_local(cfg)
+
+        def run_pass(profiled: bool) -> list[float]:
+            settings = ServiceSettings(
+                workers=4, queue_limit=64, cold_chunk=chunk,
+                refresh_s=0.0,
+                prof_hz=19.0 if profiled else 0.0,
+            )
+            with SieveService(cfg, settings) as svc, \
+                    ServiceClient(svc.addr, timeout_s=60) as cli:
+                timings: list[float] = []
+                for i in range(30):  # untimed warmup: steady state only
+                    cli.pi((101 * (i + 1)) % n)
+                workload(cli, timings)
+            return timings
+
+        p95s_off: list[float] = []
+        p95s_on: list[float] = []
+        n_reqs = 0
+        for _ in range(reps):
+            off = run_pass(profiled=False)
+            on = run_pass(profiled=True)
+            p95s_off.append(_pctile(off, 0.95))
+            p95s_on.append(_pctile(on, 0.95))
+            n_reqs = len(on)
+    p95_off = min(p95s_off)
+    p95_on = min(p95s_on)
+    ratio = p95_on / p95_off if p95_off else float("inf")
+    budget = 1.05
+    print(
+        json.dumps(
+            {
+                "metric": "service_profiler_overhead_ratio",
+                "value": round(ratio, 4),
+                "unit": "overhead_ratio",
+                "vs_baseline": round(budget / ratio, 3) if ratio else None,
+                "p95_unprofiled_ms": round(p95_off, 3),
+                "p95_profiled_ms": round(p95_on, 3),
+                "n": n_reqs,
+                "reps": reps,
+            }
+        )
+    )
+
+
 def service_lock_debug_overhead_metric() -> None:
     """Lock-sanitizer overhead (ISSUE 15): the same interleaved
     off/on, fresh-service-per-pass, untimed-warmup, client-side,
@@ -1421,6 +1520,7 @@ def main() -> int:
     router_query_latency_metric()
     service_trace_overhead_metric()
     service_recorder_overhead_metric()
+    service_profiler_overhead_metric()
     service_lock_debug_overhead_metric()
     service_observer_overhead_metric()
     service_cold_drain_throughput_metric()

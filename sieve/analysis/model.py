@@ -105,6 +105,10 @@ CANONICAL_LOCK_ORDER: tuple[str, ...] = (
     "client._wire_logger_lock",
     # -- leaf infrastructure (innermost: never call out while held)
     "ChaosSchedule._lock",
+    # continuous profiler (ISSUE 20): guards the collapsed-stack table
+    # only — held for one fold or one snapshot copy, never while
+    # walking frames, drawing chaos, or emitting metrics
+    "StackProfiler._lock",
     "FlightRecorder._lock",
     "MetricsHistory._lock",
     "MetricsRegistry._lock",

@@ -155,6 +155,18 @@ Observer plane (ISSUE 19; drawn by the fleet observer on its own
                               (gap-aware windows re-arm only after a
                               fresh real sample).
 
+Continuous profiler (ISSUE 20; drawn inline by the ``profile`` wire op
+on BOTH serving tiers — shard server and router — each on its own
+profile-pull counter):
+
+* ``svc_prof_gap:any@sK``     the K-th ``profile`` wire reply is
+                              dropped (the puller sees a timeout, never
+                              a malformed frame) and the sampler pauses
+                              one beat. ``tools/fleet_profile.py`` must
+                              ride the gap: a partial merge still
+                              lands, exit 1 names the missing process,
+                              nothing crashes, and the next pull heals.
+
 Flight recorder (ISSUE 13):
 
 * ``svc_crash:any@sK``        request K's worker thread raises uncaught
@@ -209,6 +221,7 @@ KINDS = (
     "store_torn_write",
     "svc_mesh_fail",
     "svc_scrape_gap",
+    "svc_prof_gap",
 )
 # kinds handled by the query service (sieve/service/); the cluster plane
 # ignores these and vice versa. Request-scoped kinds key on the request
@@ -252,6 +265,10 @@ ROUTER_REQUEST_KINDS = ("svc_shard_down",)
 # worker field names the target's index in the observer's target list,
 # so neither serving tier ever consumes these
 OBSERVER_KINDS = ("svc_scrape_gap",)
+# drawn inline by the ``profile`` wire op (ISSUE 20) on BOTH serving
+# tiers, each on its own profile-pull counter — the only kind two
+# planes consume, and each plane's counter keeps the draws disjoint
+PROFILE_KINDS = ("svc_prof_gap",)
 # kinds whose param is a LANE NAME ("hot"/"cold"), not seconds
 LANE_PARAM_KINDS = ("svc_flood",)
 _LANES = ("hot", "cold")
@@ -281,6 +298,7 @@ DEFAULT_PARAM: dict[str, float | str | None] = {
     "store_torn_write": None,
     "svc_mesh_fail": None,
     "svc_scrape_gap": None,
+    "svc_prof_gap": None,
 }
 
 

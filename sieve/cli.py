@@ -260,6 +260,10 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "SIEVE_SVC_DEBUG_DIR; without a dir the recorder "
                         "still runs and serves the debug wire op / "
                         "tools/fleet_debug.py inline)")
+    p.add_argument("--prof-hz", type=float, default=None, dest="prof_hz",
+                   help="continuous-profiler sampling rate (default "
+                        "SIEVE_PROF_HZ/19; 0 disables the sampler — the "
+                        "profile wire op then answers null)")
     p.add_argument("--metrics-file", default=None, dest="metrics_file")
     p.add_argument("--quiet", action="store_true",
                    help="suppress per-request stderr event lines")
@@ -324,6 +328,8 @@ def _serve(argv: list[str]) -> int:
         overrides["persist_cold"] = True
     if args.debug_dir is not None:
         overrides["debug_dir"] = args.debug_dir
+    if args.prof_hz is not None:
+        overrides["prof_hz"] = args.prof_hz
     if args.cold_backend is not None:
         overrides["cold_backend"] = args.cold_backend
     if procs > 1:
@@ -579,6 +585,9 @@ def build_route_parser() -> argparse.ArgumentParser:
                    help="flight-recorder bundle directory: a shard going "
                         "dark (router_shard_down) or a crash freezes a "
                         "timestamped postmortem bundle here")
+    p.add_argument("--prof-hz", type=float, default=None, dest="prof_hz",
+                   help="continuous-profiler sampling rate (default "
+                        "SIEVE_PROF_HZ/19; 0 disables the sampler)")
     p.add_argument("--metrics-file", default=None, dest="metrics_file")
     p.add_argument("--quiet", action="store_true",
                    help="suppress per-request stderr event lines")
@@ -616,6 +625,8 @@ def _route(argv: list[str]) -> int:
         overrides["quiet"] = True
     if args.debug_dir is not None:
         overrides["debug_dir"] = args.debug_dir
+    if args.prof_hz is not None:
+        overrides["prof_hz"] = args.prof_hz
     settings = RouterSettings.from_env(**overrides)
 
     file_sink = None

@@ -100,10 +100,14 @@ class FlightRecorder:
         span_tail: int = 256,
         event_tail: int = 256,
         history_window_s: float = 600.0,
+        profiler: Any = None,
     ):
         self.role = role
         self.debug_dir = debug_dir
         self.history = history
+        # continuous profiler (ISSUE 20): any object with .snapshot();
+        # its collapsed-stack table rides every bundle
+        self.profiler = profiler
         self.config = redact(config) if config is not None else None
         self.cooldown_s = cooldown_s
         self.span_tail = span_tail
@@ -214,6 +218,17 @@ class FlightRecorder:
         events = list(self._events)
         rows = (self.history.rows(self.history_window_s)
                 if self.history is not None else [])
+        profile = (self.profiler.snapshot()
+                   if self.profiler is not None else None)
+        if profile is not None and self._logger is not None:
+            try:
+                self._logger.event(
+                    "profile_captured", quietable=True, role=self.role,
+                    samples=profile.get("samples"),
+                    stacks=len(profile.get("stacks") or ()),
+                )
+            except Exception:  # noqa: BLE001 — snapshots run inline
+                pass
         with self._lock:
             bundles, suppressed = self._bundles, self._suppressed
         return {
@@ -231,6 +246,7 @@ class FlightRecorder:
             "errors": [e for e in events if _errorish(e.get("event"))][-20:],
             "metrics": metrics.registry().snapshot(),
             "history": [{"ts": ts, "metrics": snap} for ts, snap in rows],
+            "profile": profile,
             "recorder": {
                 "bundles": bundles,
                 "suppressed": suppressed,

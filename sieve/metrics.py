@@ -181,6 +181,14 @@ EVENT_SCHEMA: dict[str, set[str]] = {
     # a scrape cycle that raised past the per-endpoint nets: counted so
     # a silently wedged observer is visible, never fatal to the daemon
     "observer_error": {"error"},
+    # continuous profiler (ISSUE 20): profile_captured is one sampler
+    # table frozen into a FlightRecorder bundle ("samples" = thread
+    # samples folded since start, "stacks" = distinct collapsed stacks
+    # held); profile_pulled is one inline ``profile`` wire reply (or
+    # the observer's fleet-wide anomaly pull, role="observer") —
+    # "gap" flags a reply the svc_prof_gap chaos kind dropped.
+    "profile_captured": {"role", "samples", "stacks"},
+    "profile_pulled": {"role", "samples", "stacks", "gap"},
 }
 
 

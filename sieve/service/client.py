@@ -397,6 +397,15 @@ class ServiceClient:
         endpoint runs with the recorder disabled."""
         return self._call({"type": "debug"})["bundle"]
 
+    def profile(self) -> dict | None:
+        """Continuous-profiler snapshot (ISSUE 20 flame-pull op).
+
+        The endpoint's collapsed-stack table — every sample tagged with
+        its thread role and active span — answered inline like ``debug``
+        so a wedged worker pool still profiles. None when the endpoint
+        runs with the sampler disabled (SIEVE_PROF_HZ=0)."""
+        return self._call({"type": "profile"})["profile"]
+
     def exemplars(self, ctx: str | None = None,
                   n: int | None = None) -> list[dict]:
         """Kept tail-sampled exemplars (ISSUE 19), newest last.

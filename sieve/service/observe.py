@@ -589,24 +589,41 @@ class FleetObserver:
     def _pull_fleet_bundle(self, targets: list[dict],
                            k: int) -> str | None:
         """Fleet-wide flight-recorder pull (every endpoint's inline
-        ``debug`` op), written as ``anomaly_<scrape>.json`` under the
+        ``debug`` op) plus its continuous-profiler snapshot (ISSUE 20
+        ``profile`` op), written as ``anomaly_<scrape>.json`` under the
         observe dir. A partial pull still lands — each unreachable
-        endpoint carries its named error."""
+        endpoint carries its named error, and a profile gap (svc_prof_gap
+        chaos) never takes the debug half down with it."""
         if not self.settings.debug_pull or not self.settings.observe_dir:
             return None
         procs: list[dict] = []
         for tgt in targets:
             addr = tgt["addr"]
             try:
-                procs.append({"addr": addr, "role": tgt["role"],
-                              "shard": tgt["shard"],
-                              "bundle": self.pool.get(addr).debug(),
-                              "error": None})
+                row = {"addr": addr, "role": tgt["role"],
+                       "shard": tgt["shard"],
+                       "bundle": self.pool.get(addr).debug(),
+                       "error": None, "profile": None,
+                       "profile_error": None}
+                try:
+                    row["profile"] = self.pool.get(addr).profile()
+                except Exception as pe:  # noqa: BLE001 — gap != down
+                    self.pool.invalidate(addr)
+                    row["profile_error"] = f"{type(pe).__name__}: {pe}"
+                prof = row["profile"]
+                self.metrics.event(
+                    "profile_pulled", quietable=True, role="observer",
+                    samples=(prof or {}).get("samples"),
+                    stacks=len((prof or {}).get("stacks") or ()),
+                    gap=row["profile_error"] is not None,
+                )
+                procs.append(row)
             except Exception as e:  # noqa: BLE001 — partial bundle is fine
                 self.pool.invalidate(addr)
                 procs.append({"addr": addr, "role": tgt["role"],
                               "shard": tgt["shard"], "bundle": None,
-                              "error": f"{type(e).__name__}: {e}"})
+                              "error": f"{type(e).__name__}: {e}",
+                              "profile": None, "profile_error": None})
         doc = {"bundle": FLEET_BUNDLE_VERSION, "ts": time.time(),
                "trigger": "fleet_anomaly", "scrape": k,
                "processes": procs}

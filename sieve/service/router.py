@@ -70,11 +70,13 @@ from typing import Any
 from sieve.chaos import (
     ANY_WORKER,
     ChaosSchedule,
+    PROFILE_KINDS,
     ROUTER_REQUEST_KINDS,
     parse_chaos,
 )
 from sieve.enumerate import MAX_HI
 from sieve.debug import FlightRecorder
+from sieve.profile import StackProfiler
 from sieve.metrics import MetricsHistory, MetricsLogger, registry
 import numpy as np
 
@@ -173,6 +175,11 @@ class RouterSettings:
     exemplar_warmup: int = 30
     exemplar_ring: int = 256
     exemplar_file_bytes: int = 4 << 20
+    # always-on continuous profiler (ISSUE 20): same sampler as the
+    # service (shared SIEVE_PROF_* env spellings); prof_hz=0 disables
+    prof_hz: float = 19.0
+    prof_stacks: int = 512
+    prof_idle: bool = False
 
     def validate(self) -> "RouterSettings":
         for name in ("default_deadline_s", "timeout_s", "probe_timeout_s"):
@@ -199,13 +206,21 @@ class RouterSettings:
                 "positive integer"
             )
         for name in ("exemplar_baseline", "exemplar_window",
-                     "exemplar_ring", "exemplar_file_bytes"):
+                     "exemplar_ring", "exemplar_file_bytes",
+                     "prof_stacks"):
             v = getattr(self, name)
             if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
                 raise ValueError(
                     f"router settings: {name}={v!r} must be a positive "
                     "integer"
                 )
+        if (not isinstance(self.prof_hz, (int, float))
+                or isinstance(self.prof_hz, bool) or self.prof_hz < 0
+                or not math.isfinite(self.prof_hz)):
+            raise ValueError(
+                f"router settings: prof_hz={self.prof_hz!r} must be a "
+                "non-negative number"
+            )
         if (not isinstance(self.exemplar_warmup, int)
                 or isinstance(self.exemplar_warmup, bool)
                 or self.exemplar_warmup < 0):
@@ -250,6 +265,9 @@ class RouterSettings:
             exemplar_file_bytes=env.env_int(
                 "SIEVE_SVC_EXEMPLAR_FILE_BYTES", cls.exemplar_file_bytes
             ),
+            prof_hz=env.env_float("SIEVE_PROF_HZ", cls.prof_hz),
+            prof_stacks=env.env_int("SIEVE_PROF_STACKS", cls.prof_stacks),
+            prof_idle=env.env_flag("SIEVE_PROF_IDLE", False),
         )
         return dataclasses.replace(s, **overrides)
 
@@ -296,6 +314,9 @@ _ROUTER_STATS = (
     "exemplars_seen",
     "exemplars_kept",
     "exemplar_pulls",
+    # continuous profiler (ISSUE 20)
+    "profile_pulls",
+    "profile_gaps",
 )
 
 # synthetic pid base for per-shard-replica tracks in the merged trace
@@ -378,6 +399,18 @@ class SieveRouter:
         self._drained = threading.Event()
         # flight recorder (ISSUE 13): armed in start(); router_shard_down
         # is the router's edge trigger
+        # continuous profiler (ISSUE 20): built before the recorder so
+        # bundles embed its snapshot; per-conn dispatch threads draw the
+        # svc_prof_gap chaos on a shared pull counter under _stats_lock
+        self.profiler: StackProfiler | None = None
+        if s.prof_hz > 0:
+            self.profiler = StackProfiler(
+                "router",
+                hz=s.prof_hz,
+                max_stacks=s.prof_stacks,
+                include_idle=s.prof_idle,
+            )
+        self._prof_pulls = 0  # guard: _stats_lock
         self.history: MetricsHistory | None = None
         self.recorder: FlightRecorder | None = None
         if s.recorder:
@@ -389,6 +422,7 @@ class SieveRouter:
                 config=s,
                 logger=self.metrics,
                 cooldown_s=s.debug_cooldown_s,
+                profiler=self.profiler,
             )
         # tail-sampled exemplars (ISSUE 19): route-completion retention;
         # a kept route embeds the touched shards' downstream exemplars
@@ -428,6 +462,8 @@ class SieveRouter:
         if self.recorder is not None:
             self.history.start()
             self.recorder.install()
+        if self.profiler is not None:
+            self.profiler.start()
         if self.exemplar is not None:
             # arm the process tracer's exemplar span ring (independent
             # of full event capture — ``trace.enable`` stays off)
@@ -502,6 +538,8 @@ class SieveRouter:
             rs.close()
         if self.exemplar is not None:
             self.exemplar.close()
+        if self.profiler is not None:
+            self.profiler.stop()
         if self.recorder is not None:
             self.recorder.uninstall()
             self.history.stop()
@@ -1237,7 +1275,8 @@ class SieveRouter:
             with self._conns_lock:
                 self._conns.add(conn)
             t = threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="router-conn",
             )
             t.start()
 
@@ -1323,6 +1362,33 @@ class SieveRouter:
                 "type": "debug", "id": rid, "ok": True, "role": "router",
                 "bundle": (self.recorder.snapshot("manual")
                            if self.recorder is not None else None),
+            })
+            return
+        if mtype == "profile":
+            # continuous-profiler pull (ISSUE 20): inline like debug.
+            # svc_prof_gap chaos drops the K-th reply (puller times
+            # out) and pauses the sampler one beat; the shared pull
+            # counter lives under _stats_lock (per-conn threads).
+            with self._stats_lock:
+                self._prof_pulls += 1
+                pulls = self._prof_pulls
+            gap = bool(self.chaos.take_kinds(0, pulls, PROFILE_KINDS))
+            snap = (self.profiler.snapshot()
+                    if self.profiler is not None else None)
+            self.metrics.event(
+                "profile_pulled", quietable=True, role="router",
+                samples=(snap or {}).get("samples"),
+                stacks=len((snap or {}).get("stacks") or ()), gap=gap,
+            )
+            if gap:
+                self._bump("profile_gaps")
+                if self.profiler is not None:
+                    self.profiler.pause(1)
+                return
+            self._bump("profile_pulls")
+            self._reply(conn, send_lock, {
+                "type": "profile", "id": rid, "ok": True,
+                "role": "router", "profile": snap,
             })
             return
         if mtype == "exemplars":

@@ -107,12 +107,14 @@ from sieve import env, trace
 from sieve.analysis.lockdebug import named_condition, named_lock
 from sieve.backends import make_worker
 from sieve.chaos import (
+    PROFILE_KINDS,
     SERVICE_REQUEST_KINDS,
     ChaosCrash,
     ChaosSchedule,
     parse_chaos,
 )
 from sieve.debug import FlightRecorder
+from sieve.profile import StackProfiler
 from sieve.checkpoint import (
     COLD_SEG_BASE,
     Ledger,
@@ -351,6 +353,16 @@ class ServiceSettings:
     exemplar_warmup: int = 30
     exemplar_ring: int = 256
     exemplar_file_bytes: int = 4 << 20
+    # always-on continuous profiler (ISSUE 20): a daemon thread samples
+    # sys._current_frames() at prof_hz, folding stacks into a bounded
+    # collapsed-stack table (prof_stacks entries, drop-coldest) tagged
+    # with thread role and active span. Served by the ``profile`` wire
+    # op, snapshotted into every flight-recorder bundle. prof_hz=0
+    # disables; prof_idle=True also keeps samples whose leaf is a
+    # known parked wait (off by default so shares reflect real work).
+    prof_hz: float = 19.0
+    prof_stacks: int = 512
+    prof_idle: bool = False
 
     def validate(self) -> "ServiceSettings":
         """Typed startup validation: every rejection names the setting
@@ -362,7 +374,8 @@ class ServiceSettings:
                      "max_primes", "max_pair_span", "breaker_fails",
                      "batch_queries", "write_queue_bytes",
                      "exemplar_baseline", "exemplar_window",
-                     "exemplar_ring", "exemplar_file_bytes"):
+                     "exemplar_ring", "exemplar_file_bytes",
+                     "prof_stacks"):
             v = getattr(self, name)
             if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
                 raise ValueError(
@@ -386,7 +399,7 @@ class ServiceSettings:
             )
         for name in ("refresh_s", "drain_s", "cold_delay_s", "cold_age_s",
                      "breaker_cooldown_s", "debug_cooldown_s",
-                     "metrics_sample_s"):
+                     "metrics_sample_s", "prof_hz"):
             v = getattr(self, name)
             if not isinstance(v, (int, float)) or isinstance(v, bool) \
                     or v < 0 or not math.isfinite(v):
@@ -561,6 +574,9 @@ class ServiceSettings:
             exemplar_file_bytes=_env_int(
                 "SIEVE_SVC_EXEMPLAR_FILE_BYTES", cls.exemplar_file_bytes
             ),
+            prof_hz=_env_float("SIEVE_PROF_HZ", cls.prof_hz),
+            prof_stacks=_env_int("SIEVE_PROF_STACKS", cls.prof_stacks),
+            prof_idle=_env_bool("SIEVE_PROF_IDLE", "0"),
         )
         return dataclasses.replace(s, **overrides)
 
@@ -1160,6 +1176,8 @@ _STATS = (
     "wire_v2_conns",
     "exemplars_seen",
     "exemplars_kept",
+    "profile_pulls",
+    "profile_gaps",
 )
 
 
@@ -1366,6 +1384,19 @@ class SieveService:
         # flight recorder (ISSUE 13): trend sampler + black-box capture,
         # armed in start(); edge triggers (SLO burn, breaker open,
         # crash) freeze bundles under settings.debug_dir
+        # continuous profiler (ISSUE 20): low-rate stack sampler feeding
+        # the ``profile`` wire op and every recorder bundle; built before
+        # the recorder so bundles can embed its snapshot
+        self.profiler: StackProfiler | None = None
+        if self.settings.prof_hz > 0:
+            self.profiler = StackProfiler(
+                "service",
+                hz=self.settings.prof_hz,
+                max_stacks=self.settings.prof_stacks,
+                include_idle=self.settings.prof_idle,
+            )
+        self._prof_pulls = 0  # guard: none(wire-thread only: the
+        # profile op is dispatched inline on svc-wire)
         self.history: MetricsHistory | None = None
         self.recorder: FlightRecorder | None = None
         if self.settings.recorder:
@@ -1379,6 +1410,7 @@ class SieveService:
                 config=config,
                 logger=self.metrics,
                 cooldown_s=self.settings.debug_cooldown_s,
+                profiler=self.profiler,
             )
         # tail-sampled exemplars (ISSUE 19): completion-time retention of
         # span trees — errors/demotions always, the slow tail past the
@@ -1471,6 +1503,8 @@ class SieveService:
         if self.recorder is not None:
             self.history.start()
             self.recorder.install()
+        if self.profiler is not None:
+            self.profiler.start()
         if self.exemplar is not None:
             # arm the process tracer's exemplar span ring (independent of
             # full event capture — ``trace.enable`` stays off)
@@ -1546,6 +1580,8 @@ class SieveService:
             self.store.close()
         if self.exemplar is not None:
             self.exemplar.close()
+        if self.profiler is not None:
+            self.profiler.stop()
         if self.recorder is not None:
             self.recorder.uninstall()
             self.history.stop()
@@ -2144,6 +2180,33 @@ class SieveService:
                 "type": "debug", "id": rid, "ok": True, "role": "service",
                 "bundle": (self.recorder.snapshot("manual")
                            if self.recorder is not None else None),
+            }, front=True)
+            return None
+        if mtype == "profile":
+            # continuous-profiler pull (ISSUE 20): collapsed-stack table,
+            # inline from the event loop like debug — a wedged worker
+            # pool still profiles. svc_prof_gap chaos drops the K-th
+            # reply (puller times out, never sees a malformed frame) and
+            # pauses the sampler one beat.
+            self._prof_pulls += 1
+            gap = bool(self.chaos.take_kinds(0, self._prof_pulls,
+                                             PROFILE_KINDS))
+            snap = (self.profiler.snapshot()
+                    if self.profiler is not None else None)
+            self.metrics.event(
+                "profile_pulled", quietable=True, role="service",
+                samples=(snap or {}).get("samples"),
+                stacks=len((snap or {}).get("stacks") or ()), gap=gap,
+            )
+            if gap:
+                self._bump("profile_gaps")
+                if self.profiler is not None:
+                    self.profiler.pause(1)
+                return None
+            self._bump("profile_pulls")
+            self._reply(conn, {
+                "type": "profile", "id": rid, "ok": True,
+                "role": "service", "profile": snap,
             }, front=True)
             return None
         if mtype == "exemplars":

@@ -62,6 +62,32 @@ def now_s() -> float:
     return time.perf_counter() - _EPOCH
 
 
+# Per-thread open-span stacks (ISSUE 20): the continuous profiler tags
+# each sampled thread with its innermost active span label. Keyed by
+# thread ident; each thread only ever mutates ITS OWN list, and every
+# operation is a single GIL-atomic dict/list op, so neither the span
+# hot path nor the sampler's cross-thread read takes a lock. Entries
+# for finished threads linger (bounded by peak thread count) — idents
+# are reused, so a successor thread simply adopts the empty list.
+_ACTIVE_SPANS: dict[int, list] = {}
+
+
+def active_label(tid: int | None = None) -> str | None:
+    """The innermost open span name on thread ``tid`` (calling thread
+    when None), or None when no span is open. Safe from any thread: a
+    race with the owner's push/pop yields a momentarily-stale label,
+    never a crash."""
+    stack = _ACTIVE_SPANS.get(
+        tid if tid is not None else threading.get_ident()
+    )
+    if not stack:
+        return None
+    try:
+        return stack[-1]
+    except IndexError:
+        return None  # owner popped between the check and the read
+
+
 def _ex_root(ctx: str) -> str:
     """The root request context of a span ctx: ``run_id/seq.attempt``,
     i.e. the first two ``/``-separated components. Child contexts append
@@ -91,12 +117,22 @@ class Span:
         self.elapsed = 0.0
 
     def __enter__(self) -> "Span":
+        # push onto this thread's open-span stack (profiler tag, ISSUE
+        # 20): single-dict-op per direction, no lock — see _ACTIVE_SPANS
+        tid = threading.get_ident()
+        stack = _ACTIVE_SPANS.get(tid)
+        if stack is None:
+            stack = _ACTIVE_SPANS[tid] = []
+        stack.append(self.name)
         self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc) -> bool:
         t1 = time.perf_counter()
         self.elapsed = t1 - self.t0
+        stack = _ACTIVE_SPANS.get(threading.get_ident())
+        if stack:
+            stack.pop()
         self._tracer._record(
             self.name, self.t0 - _EPOCH, t1 - _EPOCH, self.args
         )

@@ -413,8 +413,15 @@ def test_fleet_top_snapshot_schema_and_render(src_dir, tmp_path):
         snap2 = fleet_snapshot(f.router.addr, timeout_s=10)
         frame2 = render(snap2, prev=snap)
         assert "/s" in frame2  # second frame shows rates, not totals
-        # no SLOs configured: burn renders "-", never a fake 0
-        assert frame2.rstrip().endswith("-")
+        # no SLOs configured: burn renders "-", never a fake 0 (the
+        # hot-frame column, ISSUE 20, now rides to the right of it)
+        hdr = [ln for ln in frame2.splitlines() if "slo burn" in ln][0]
+        assert "hot frame" in hdr
+        col = hdr.index("slo burn")
+        rows = [ln for ln in frame2.splitlines()
+                if ln.lstrip().startswith(("s0 ", "s1 "))]
+        assert rows and all(
+            ln[col:col + len("slo burn")].strip() == "-" for ln in rows)
 
 
 def test_fleet_top_unreachable_router_renders_error():

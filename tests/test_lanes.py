@@ -174,7 +174,8 @@ def test_health_and_stats_key_schema_snapshot(service):
         "internal_errors", "lane_shed_cold", "lane_shed_hot",
         "lru_entries", "lru_hits", "materialized", "mesh_devices",
         "mesh_fallbacks", "mesh_fanout", "mesh_launches", "persist_cold",
-        "proc_index", "procs", "queue_depth", "queue_depth_cold",
+        "proc_index", "procs", "profile_gaps", "profile_pulls",
+        "queue_depth", "queue_depth_cold",
         "queue_depth_hot", "range_lo", "refresh_attempts",
         "refresh_failed", "refreshes", "requests", "segments", "shed",
         "slo", "slow_consumer_closed", "snapshot_age_s", "store",

@@ -516,6 +516,7 @@ def test_router_health_and_stats_key_schema_snapshot(src_dirs, tmp_path):
             "batch_rpcs", "deadline_exceeded", "draining",
             "draining_replies", "exemplar_pulls", "exemplars_kept",
             "exemplars_seen", "failovers", "internal_errors", "probes",
+            "profile_gaps", "profile_pulls",
             "range_hi", "range_lo", "requests", "routed_point",
             "scattered", "shard_count", "shard_down_windows",
             "shard_errors", "shed_relayed", "spliced",

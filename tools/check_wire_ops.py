@@ -14,7 +14,8 @@ regex-harvests every literal op (``op == "..."``) and message type
 * every server message type is either routed or explicitly listed in
   ``router.UNROUTED_TYPES`` (typed-rejected, with the reason written
   next to the constant);
-* the ``batch`` op (ISSUE 14) appears on BOTH sides.
+* the ``batch`` op (ISSUE 14) and the ``profile`` message type
+  (ISSUE 20) appear on BOTH sides.
 
 Binary wire v2 (ISSUE 16) adds a LIVE leg: ``check_encodings`` boots a
 tiny in-process service and replays every query op through a v1 (JSON)
@@ -81,6 +82,12 @@ def check() -> list[str]:
         if "batch" not in ops:
             problems.append(
                 f"the batch op (ISSUE 14) is missing from the {side}"
+            )
+    for side, types in (("server", server_types),
+                        ("router", router_types)):
+        if "profile" not in types:
+            problems.append(
+                f"the profile op (ISSUE 20) is missing from the {side}"
             )
     return problems
 

@@ -8,8 +8,10 @@ depths, shed/demotion rates, LRU and cold-cache hit rates, cold dispatch
 rate, the cold-backend class column (``mesh/DxF`` = D mesh devices at
 last-drain chunk fanout F, or the loop backend name — ISSUE 18),
 the segment-store column (hit ratio / demotions, plus a ``T<n>``
-torn-entry marker — ISSUE 17), covered_hi, and the worst per-op SLO
-burn — plus a router header
+torn-entry marker — ISSUE 17), covered_hi, the worst per-op SLO
+burn, and the ``hot frame`` column (ISSUE 20: the top self-time frame
+from a cached low-rate pull of each process's continuous profiler,
+refreshed at most every 10s) — plus a router header
 with request rate, totals-cache hit rate, telemetry merge/gap counters,
 and fabric coverage contiguity. Rates are deltas between consecutive
 polls; the first frame shows totals only.
@@ -37,6 +39,7 @@ from typing import Any
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from sieve.profile import self_times  # noqa: E402
 from sieve.service.client import ClientPool, ServiceClient  # noqa: E402
 from tools.trace_report import _sparkline  # noqa: E402
 
@@ -45,9 +48,52 @@ _CLEAR = "\x1b[2J\x1b[H"
 # snapshots of trend history per sparkline cell (--observe-dir)
 _TREND_DEPTH = 30
 
+# the hot-frame column (ISSUE 20) refreshes its per-endpoint profile
+# pull at most this often — a watch session must not turn the profiler
+# into a per-poll tax
+_PROF_REFRESH_S = 10.0
+
+
+def _hot_frame(profile: dict | None) -> str:
+    """Top SELF-time frame of one endpoint's profile document, or ``-``
+    (profiler disabled, or no samples yet)."""
+    if not profile:
+        return "-"
+    merged = {r["stack"]: {"count": r["count"], "role": r.get("role")}
+              for r in profile.get("stacks") or []}
+    rows = self_times(merged, 1)
+    if not rows:
+        return "-"
+    return f"{rows[0]['frame']} {rows[0]['share']:.0%}"
+
+
+def _hot_frame_cached(cli: "ServiceClient", addr: str,
+                      prof_cache: dict | None) -> str:
+    """The endpoint's hot frame from a cached low-rate profile pull.
+
+    A failed pull (old server, ``svc_prof_gap`` drop) degrades to the
+    cached cell — never the row's health."""
+    if prof_cache is None:
+        try:
+            return _hot_frame(cli.profile())
+        except Exception:  # noqa: BLE001
+            return "-"
+    now = time.time()
+    ent = prof_cache.get(addr)
+    if ent is not None and now - ent[0] < _PROF_REFRESH_S:
+        return ent[1]
+    cell = ent[1] if ent is not None else "-"
+    try:
+        cell = _hot_frame(cli.profile())
+    except Exception:  # noqa: BLE001 — keep the stale cell
+        pass
+    prof_cache[addr] = (now, cell)
+    return cell
+
 
 def _poll(addr: str, timeout_s: float,
-          pool: ClientPool | None = None) -> dict[str, Any]:
+          pool: ClientPool | None = None,
+          prof_cache: dict | None = None) -> dict[str, Any]:
     """health + stats + metrics of one endpoint, or a named error.
 
     With a ``pool`` (ISSUE 14) the endpoint's pipelined connection is
@@ -63,6 +109,7 @@ def _poll(addr: str, timeout_s: float,
                 "health": cli.health(),
                 "stats": cli.stats(),
                 "metrics": cli.metrics(),
+                "hot_frame": _hot_frame_cached(cli, addr, prof_cache),
                 "error": None,
             }
         with ServiceClient(addr, timeout_s=timeout_s) as cli:
@@ -71,24 +118,29 @@ def _poll(addr: str, timeout_s: float,
                 "health": cli.health(),
                 "stats": cli.stats(),
                 "metrics": cli.metrics(),
+                "hot_frame": _hot_frame_cached(cli, addr, prof_cache),
                 "error": None,
             }
     except Exception as e:  # noqa: BLE001 — a dead replica is a table row
         if pool is not None:
             pool.invalidate(addr)
         return {"addr": addr, "health": None, "stats": None,
-                "metrics": None, "error": f"{type(e).__name__}: {e}"}
+                "metrics": None, "hot_frame": "-",
+                "error": f"{type(e).__name__}: {e}"}
 
 
 def fleet_snapshot(router_addr: str, timeout_s: float = 5.0,
-                   pool: ClientPool | None = None) -> dict:
+                   pool: ClientPool | None = None,
+                   prof_cache: dict | None = None) -> dict:
     """One poll of the whole fleet (pure data; rendering is separate).
 
     Returns ``{"ts": epoch_s, "router": {...}, "shards": [...]}`` where
     each shard entry carries the router's view (range, status) plus a
     polled row per replica address. Pass one :class:`ClientPool` across
-    consecutive calls to reuse every endpoint's connection."""
-    router = _poll(router_addr, timeout_s, pool)
+    consecutive calls to reuse every endpoint's connection, and one
+    ``prof_cache`` dict to rate-limit the hot-frame profile pulls
+    (ISSUE 20) to one per endpoint per ``_PROF_REFRESH_S``."""
+    router = _poll(router_addr, timeout_s, pool, prof_cache)
     shards: list[dict[str, Any]] = []
     h = router["health"]
     if h is not None:
@@ -99,7 +151,8 @@ def fleet_snapshot(router_addr: str, timeout_s: float = 5.0,
                 "hi": ent.get("hi"),
                 "status": ent.get("status"),
                 "replicas": [
-                    _poll(a, timeout_s, pool) for a in ent.get("addrs", [])
+                    _poll(a, timeout_s, pool, prof_cache)
+                    for a in ent.get("addrs", [])
                 ],
             })
     return {"ts": time.time(), "router": router, "shards": shards}
@@ -258,7 +311,8 @@ def render(snap: dict, prev: dict | None = None,
         f"totals-cache hit={_ratio(tot_hit, tot_hit + tot_miss)}  "
         f"telemetry merged={rs.get('telemetry_merged', 0)} "
         f"gaps={rs.get('telemetry_gaps', 0)}  "
-        f"failovers={rs.get('failovers', 0)}"
+        f"failovers={rs.get('failovers', 0)}  "
+        f"hot={r.get('hot_frame', '-')}"
     )
     lines.append("")
     trend_hdr = (f" {'hot trend':>{_TREND_DEPTH}} "
@@ -268,7 +322,8 @@ def render(snap: dict, prev: dict | None = None,
         f"  {'replica':<22} {'st':<4} {'hot':>4} {'cold':>4} "
         f"{'shed':>8} {'demote':>8} {'lru':>5} {'ccache':>6} "
         f"{'colddisp':>9} {'cbackend':>10} {'store':>12} "
-        f"{'covered_hi':>11} {'slo burn':>9}" + trend_hdr
+        f"{'covered_hi':>11} {'slo burn':>9} {'hot frame':<28}"
+        + trend_hdr
     )
     for sh in snap["shards"]:
         for rep in sh["replicas"]:
@@ -306,7 +361,8 @@ def render(snap: dict, prev: dict | None = None,
                 f"{_rate(st, ps, 'cold_dispatches', dt):>9} "
                 f"{_cold_cell(st):>10} "
                 f"{_store_cell(st):>12} "
-                f"{h.get('covered_hi', 0):>11} {_worst_burn(st):>9}"
+                f"{h.get('covered_hi', 0):>11} {_worst_burn(st):>9} "
+                f"{rep.get('hot_frame', '-'):<28}"
                 + trend_cells
             )
     return "\n".join(lines)
@@ -341,11 +397,15 @@ def main(argv: list[str] | None = None) -> int:
     # one pipelined client per endpoint, reused across refresh cycles
     # (ISSUE 14): a watch session costs one connect per target, not one
     # per poll; reconnects are counted and shown in the header
+    # the hot-frame cells refresh from a rate-limited profile pull
+    # (ISSUE 20): one per endpoint per _PROF_REFRESH_S, not per poll
+    prof_cache: dict = {}
     with ClientPool(timeout_s=args.timeout) as pool:
         try:
             while True:
                 snap = fleet_snapshot(args.router_addr,
-                                      timeout_s=args.timeout, pool=pool)
+                                      timeout_s=args.timeout, pool=pool,
+                                      prof_cache=prof_cache)
                 trends = (ring_trends(args.observe_dir)
                           if args.observe_dir else None)
                 frame = render(snap, prev, trends=trends)

@@ -878,6 +878,23 @@ def _one_bundle_lines(b: dict, max_series: int = 12,
             lines.append(f"    {e.get('event', '?'):<24} {_compact(e)}")
     else:
         lines.append("  last errors: none recorded")
+    prof = b.get("profile")
+    if prof:
+        from sieve.profile import self_times
+
+        merged = {r["stack"]: {"count": r["count"],
+                               "role": r.get("role")}
+                  for r in prof.get("stacks") or []}
+        lines.append(
+            f"  profile ({prof.get('hz')} Hz, "
+            f"{prof.get('samples', 0)} samples, "
+            f"{len(prof.get('stacks') or [])} stacks, "
+            f"{prof.get('evicted', 0)} evicted) — top self-time:"
+        )
+        for r in self_times(merged, 8):
+            lines.append(
+                f"    {r['frame']:<38} {r['self']:>6}  {r['share']:.1%}"
+            )
     return lines
 
 
